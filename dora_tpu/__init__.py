@@ -1,0 +1,32 @@
+"""dora-tpu: a TPU-native dataflow framework.
+
+A YAML-described graph of nodes exchanging Apache-Arrow messages through a
+per-machine daemon, coordinated across machines by a control-plane
+coordinator — with a first-class TPU execution tier: operators marked
+``runtime: tpu`` are JAX-traced functions fused into a single XLA computation
+per dataflow tick, so tensors stay in device HBM across node boundaries.
+
+Capability blueprint: the dora-rs reference (see SURVEY.md). This package is
+a ground-up TPU-first design, not a port.
+"""
+
+__version__ = "0.1.0"
+
+# The wire-protocol version; nodes and daemons refuse to talk across
+# incompatible protocol versions (reference: dora-message semver check,
+# libraries/message/src/lib.rs:28-43).
+PROTOCOL_VERSION = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy re-exports so that `import dora_tpu` stays cheap for CLI tools
+    # and subprocess nodes (jax import alone costs ~2s).
+    if name == "Node":
+        from dora_tpu.node.node import Node
+
+        return Node
+    if name == "Descriptor":
+        from dora_tpu.core.descriptor import Descriptor
+
+        return Descriptor
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,11 @@
+"""Core graph model: typed config, YAML descriptor, validation, topics."""
+
+from dora_tpu.core.config import (  # noqa: F401
+    CommunicationConfig,
+    Input,
+    InputMapping,
+    LocalCommunicationConfig,
+    TimerMapping,
+    UserMapping,
+)
+from dora_tpu.core.descriptor import Descriptor, ResolvedNode  # noqa: F401

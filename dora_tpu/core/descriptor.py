@@ -1,0 +1,372 @@
+"""The YAML dataflow descriptor: parse, resolve, validate, visualize.
+
+Reference parity: dora-core Descriptor
+(libraries/core/src/descriptor/mod.rs:25-260): four node kinds — Standard
+(``path:``), Custom (``custom:``), Runtime (``operators:``), SingleOperator
+(``operator:``) — resolved into a uniform ``ResolvedNode`` list; operator
+sources SharedLibrary|Python; ``SHELL_SOURCE``/``DYNAMIC_SOURCE`` markers.
+
+TPU-first additions:
+  * operator source ``jax: module.path:factory`` (or a ``.py`` path exposing
+    the factory) — a JAX-traced operator function executed on the TPU tier.
+  * contiguous subgraphs of jax operators are fused into one XLA computation
+    per tick by the TPU runtime (see dora_tpu.tpu.fuse).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+import yaml
+
+from dora_tpu.core.config import (
+    CommunicationConfig,
+    Input,
+    TimerMapping,
+    UserMapping,
+    expand_env,
+)
+from dora_tpu.ids import DataId, NodeId, OperatorId, OutputId
+
+# Special `path:` markers.
+SHELL_SOURCE = "shell"
+DYNAMIC_SOURCE = "dynamic"
+
+DEFAULT_OPERATOR_ID = "op"
+
+
+# ---------------------------------------------------------------------------
+# Operator model
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PythonSource:
+    """A Python operator: a .py file defining ``class Operator`` with
+    ``on_event(event, send_output) -> DoraStatus``."""
+
+    source: str
+    conda_env: str | None = None
+
+
+@dataclass(frozen=True)
+class SharedLibrarySource:
+    """A native operator: shared library exporting the C operator ABI
+    (dora_init_operator / dora_on_event / dora_drop_operator)."""
+
+    source: str
+
+
+@dataclass(frozen=True)
+class JaxSource:
+    """A TPU-tier operator: ``module.path:factory`` or ``file.py:factory``.
+
+    The factory returns a :class:`dora_tpu.tpu.api.JaxOperator` — a pure
+    function ``(state, inputs) -> (state, outputs)`` plus init state —
+    which the TPU runtime traces and fuses with adjacent jax operators.
+    """
+
+    source: str
+
+    def split(self) -> tuple[str, str]:
+        mod, sep, fn = self.source.partition(":")
+        return (mod, fn if sep else "make_operator")
+
+
+OperatorSource = PythonSource | SharedLibrarySource | JaxSource
+
+
+@dataclass(frozen=True)
+class OperatorDefinition:
+    id: OperatorId
+    source: OperatorSource
+    inputs: dict[DataId, Input] = field(default_factory=dict)
+    outputs: frozenset[DataId] = frozenset()
+    name: str | None = None
+    description: str | None = None
+    build: str | None = None
+    send_stdout_as: str | None = None
+
+    @classmethod
+    def parse(cls, value: Mapping[str, Any], default_id: str | None = None) -> "OperatorDefinition":
+        op_id = value.get("id", default_id)
+        if op_id is None:
+            raise ValueError(f"operator missing 'id': {value!r}")
+        sources = [k for k in ("python", "shared-library", "jax") if k in value]
+        if len(sources) != 1:
+            raise ValueError(
+                f"operator {op_id!r} must have exactly one of "
+                f"python/shared-library/jax, got {sources}"
+            )
+        kind = sources[0]
+        raw = value[kind]
+        if kind == "python":
+            if isinstance(raw, Mapping):
+                source: OperatorSource = PythonSource(
+                    source=str(raw["source"]), conda_env=raw.get("conda_env")
+                )
+            else:
+                source = PythonSource(source=str(raw))
+        elif kind == "shared-library":
+            source = SharedLibrarySource(source=str(raw))
+        else:
+            source = JaxSource(source=str(raw))
+        return cls(
+            id=OperatorId(str(op_id)),
+            source=source,
+            inputs=_parse_inputs(value.get("inputs")),
+            outputs=_parse_outputs(value.get("outputs")),
+            name=value.get("name"),
+            description=value.get("description"),
+            build=value.get("build"),
+            send_stdout_as=value.get("send_stdout_as"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Node model
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Deploy:
+    machine: str | None = None
+
+    @classmethod
+    def parse(cls, value: Mapping[str, Any] | None) -> "Deploy":
+        if not value:
+            return cls()
+        return cls(machine=value.get("machine"))
+
+
+@dataclass(frozen=True)
+class CustomNode:
+    """A node that is its own executable (or a dynamic/externally-attached
+    process)."""
+
+    source: str
+    args: str | None = None
+    build: str | None = None
+    send_stdout_as: str | None = None
+    inputs: dict[DataId, Input] = field(default_factory=dict)
+    outputs: frozenset[DataId] = frozenset()
+
+    @property
+    def is_dynamic(self) -> bool:
+        return self.source == DYNAMIC_SOURCE
+
+
+@dataclass(frozen=True)
+class RuntimeNode:
+    """A node hosting operators inside the operator runtime."""
+
+    operators: tuple[OperatorDefinition, ...]
+
+
+@dataclass(frozen=True)
+class ResolvedNode:
+    id: NodeId
+    name: str | None
+    description: str | None
+    env: dict[str, Any]
+    deploy: Deploy
+    kind: CustomNode | RuntimeNode
+
+    @property
+    def inputs(self) -> dict[DataId, Input]:
+        """All inputs, namespaced ``<op>/<input>`` for runtime nodes."""
+        if isinstance(self.kind, CustomNode):
+            return dict(self.kind.inputs)
+        out: dict[DataId, Input] = {}
+        for op in self.kind.operators:
+            for input_id, inp in op.inputs.items():
+                out[DataId(f"{op.id}/{input_id}")] = inp
+        return out
+
+    @property
+    def outputs(self) -> frozenset[DataId]:
+        """All outputs, namespaced ``<op>/<output>`` for runtime nodes."""
+        if isinstance(self.kind, CustomNode):
+            return self.kind.outputs
+        return frozenset(
+            DataId(f"{op.id}/{o}") for op in self.kind.operators for o in op.outputs
+        )
+
+    @property
+    def send_stdout_as(self) -> str | None:
+        if isinstance(self.kind, CustomNode):
+            return self.kind.send_stdout_as
+        for op in self.kind.operators:
+            if op.send_stdout_as:
+                return f"{op.id}/{op.send_stdout_as}"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Parsing helpers
+# ---------------------------------------------------------------------------
+
+
+def _parse_inputs(value: Any) -> dict[DataId, Input]:
+    if value is None:
+        return {}
+    if not isinstance(value, Mapping):
+        raise ValueError(f"'inputs' must be a mapping, got {type(value).__name__}")
+    return {DataId(str(k)): Input.parse(v) for k, v in value.items()}
+
+
+def _parse_outputs(value: Any) -> frozenset[DataId]:
+    if value is None:
+        return frozenset()
+    if not isinstance(value, (list, tuple)):
+        raise ValueError(f"'outputs' must be a list, got {type(value).__name__}")
+    return frozenset(DataId(str(v)) for v in value)
+
+
+_NODE_KIND_KEYS = ("path", "custom", "operators", "operator")
+
+
+# ---------------------------------------------------------------------------
+# Descriptor
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Descriptor:
+    """A parsed dataflow YAML."""
+
+    nodes: tuple[ResolvedNode, ...]
+    communication: CommunicationConfig = field(default_factory=CommunicationConfig)
+    raw: dict[str, Any] = field(default_factory=dict, compare=False)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def read(cls, path: str | Path) -> "Descriptor":
+        path = Path(path)
+        text = path.read_text()
+        return cls.parse(yaml.safe_load(text))
+
+    @classmethod
+    def parse(cls, raw: Mapping[str, Any]) -> "Descriptor":
+        if not isinstance(raw, Mapping):
+            raise ValueError("dataflow descriptor must be a YAML mapping")
+        known = {"nodes", "communication", "_unstable_deploy", "env"}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown top-level keys: {sorted(unknown)}")
+        nodes_raw = raw.get("nodes")
+        if not nodes_raw:
+            raise ValueError("dataflow has no nodes")
+        global_env = raw.get("env") or {}
+        nodes = tuple(cls._parse_node(n, global_env) for n in nodes_raw)
+        ids = [n.id for n in nodes]
+        dupes = {i for i in ids if ids.count(i) > 1}
+        if dupes:
+            raise ValueError(f"duplicate node ids: {sorted(dupes)}")
+        return cls(
+            nodes=nodes,
+            communication=CommunicationConfig.parse(raw.get("communication")),
+            raw=dict(raw),
+        )
+
+    @classmethod
+    def _parse_node(cls, value: Mapping[str, Any], global_env: Mapping[str, Any]) -> ResolvedNode:
+        if "id" not in value:
+            raise ValueError(f"node missing 'id': {value!r}")
+        node_id = NodeId(str(value["id"]))
+        kinds = [k for k in _NODE_KIND_KEYS if k in value]
+        if len(kinds) != 1:
+            raise ValueError(
+                f"node {node_id!r} must have exactly one of {_NODE_KIND_KEYS}, got {kinds}"
+            )
+        env = {**global_env, **(value.get("env") or {})}
+        env = {str(k): expand_env(v) for k, v in env.items()}
+        kind_key = kinds[0]
+
+        if kind_key == "path":
+            kind: CustomNode | RuntimeNode = CustomNode(
+                source=expand_env(str(value["path"])),
+                args=value.get("args"),
+                build=value.get("build"),
+                send_stdout_as=value.get("send_stdout_as"),
+                inputs=_parse_inputs(value.get("inputs")),
+                outputs=_parse_outputs(value.get("outputs")),
+            )
+        elif kind_key == "custom":
+            c = value["custom"]
+            kind = CustomNode(
+                source=expand_env(str(c["source"])),
+                args=c.get("args"),
+                build=c.get("build"),
+                send_stdout_as=c.get("send_stdout_as"),
+                inputs=_parse_inputs(c.get("inputs")),
+                outputs=_parse_outputs(c.get("outputs")),
+            )
+            env = {**env, **{str(k): expand_env(v) for k, v in (c.get("envs") or {}).items()}}
+        elif kind_key == "operators":
+            ops = tuple(OperatorDefinition.parse(o) for o in value["operators"])
+            if not ops:
+                raise ValueError(f"node {node_id!r} has an empty 'operators' list")
+            op_ids = [o.id for o in ops]
+            if len(set(op_ids)) != len(op_ids):
+                raise ValueError(f"node {node_id!r} has duplicate operator ids")
+            kind = RuntimeNode(operators=ops)
+        else:  # single "operator" shorthand -> runtime node with one operator
+            op = OperatorDefinition.parse(value["operator"], default_id=DEFAULT_OPERATOR_ID)
+            kind = RuntimeNode(operators=(op,))
+
+        return ResolvedNode(
+            id=node_id,
+            name=value.get("name"),
+            description=value.get("description"),
+            env=env,
+            deploy=Deploy.parse(value.get("deploy") or value.get("_unstable_deploy")),
+            kind=kind,
+        )
+
+    # -- queries ------------------------------------------------------------
+
+    def node(self, node_id: NodeId | str) -> ResolvedNode:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        raise KeyError(f"no node {node_id!r} in dataflow")
+
+    def output_ids(self) -> set[OutputId]:
+        out: set[OutputId] = set()
+        for n in self.nodes:
+            for o in n.outputs:
+                out.add(OutputId(n.id, o))
+        return out
+
+    def machines(self) -> set[str]:
+        return {n.deploy.machine or "" for n in self.nodes}
+
+    def check(self, working_dir: str | Path | None = None) -> None:
+        from dora_tpu.core.validate import check_dataflow
+
+        check_dataflow(self, working_dir)
+
+    def visualize_as_mermaid(self) -> str:
+        from dora_tpu.core.visualize import visualize_as_mermaid
+
+        return visualize_as_mermaid(self)
+
+
+def new_dataflow_uuid() -> str:
+    """UUIDv7-style (time-ordered) dataflow id, as the reference uses."""
+    # uuid.uuid7 landed in 3.14; compose one: 48-bit unix-ms + random.
+    import os
+    import time
+
+    ms = time.time_ns() // 1_000_000
+    rand = os.urandom(10)
+    b = ms.to_bytes(6, "big") + rand
+    b = bytearray(b)
+    b[6] = (b[6] & 0x0F) | 0x70  # version 7
+    b[8] = (b[8] & 0x3F) | 0x80  # variant
+    return str(uuid.UUID(bytes=bytes(b)))

@@ -1,0 +1,20 @@
+"""Well-known ports and topics.
+
+Reference parity: libraries/core/src/topics.rs:3-8. We keep the same default
+port numbers so dataflows migrating from the reference need no config change.
+"""
+
+# Coordinator listens here for daemon registrations (data-plane control).
+DORA_COORDINATOR_PORT_DEFAULT = 53290
+
+# Each daemon listens here for dynamic-node connections on its machine.
+DORA_DAEMON_LOCAL_LISTEN_PORT_DEFAULT = 53291
+
+# Coordinator listens here for CLI control connections.
+DORA_COORDINATOR_PORT_CONTROL_DEFAULT = 6012
+
+MANUAL_STOP = "dora/stop"
+
+# Outputs larger than this are passed via shared memory instead of inline
+# bytes (reference: ZERO_COPY_THRESHOLD, apis/rust/node/src/node/mod.rs:40).
+ZERO_COPY_THRESHOLD = 4096

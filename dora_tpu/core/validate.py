@@ -1,0 +1,109 @@
+"""Dataflow validation.
+
+Reference parity: libraries/core/src/descriptor/validate.rs:15-190 — source
+paths exist, every input maps to a declared output of an existing node, no
+self-cycles through timers needed, python version match (N/A here: single
+interpreter).
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+from dora_tpu.core.config import TimerMapping, UserMapping
+from dora_tpu.core.descriptor import (
+    DYNAMIC_SOURCE,
+    SHELL_SOURCE,
+    CustomNode,
+    Descriptor,
+    JaxSource,
+    PythonSource,
+    RuntimeNode,
+    SharedLibrarySource,
+)
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def check_dataflow(descriptor: Descriptor, working_dir: str | Path | None = None) -> None:
+    """Raise ValidationError on the first problem found."""
+    working_dir = Path(working_dir) if working_dir else None
+
+    declared_outputs = descriptor.output_ids()
+    node_ids = {n.id for n in descriptor.nodes}
+
+    for node in descriptor.nodes:
+        # 1. sources resolvable
+        if isinstance(node.kind, CustomNode):
+            _check_custom_source(node.id, node.kind, working_dir)
+        else:
+            assert isinstance(node.kind, RuntimeNode)
+            for op in node.kind.operators:
+                _check_operator_source(node.id, op.id, op.source, working_dir)
+
+        # 2. every input refers to an existing node + declared output
+        for input_id, inp in node.inputs.items():
+            m = inp.mapping
+            if isinstance(m, TimerMapping):
+                continue
+            assert isinstance(m, UserMapping)
+            if m.source not in node_ids:
+                raise ValidationError(
+                    f"input {node.id}/{input_id}: source node {m.source!r} does not exist"
+                )
+            if m.output_id not in declared_outputs:
+                raise ValidationError(
+                    f"input {node.id}/{input_id}: node {m.source!r} has no "
+                    f"output {m.output!r}"
+                )
+
+
+def _check_custom_source(node_id, kind: CustomNode, working_dir: Path | None) -> None:
+    source = kind.source
+    if source in (DYNAMIC_SOURCE, SHELL_SOURCE):
+        return
+    if "://" in source:  # URL source, downloaded at spawn time
+        return
+    path = Path(source)
+    if working_dir and not path.is_absolute():
+        path = working_dir / path
+    if path.exists():
+        return
+    # Not a file — accept anything on PATH (e.g. "python", an installed
+    # node-hub entry point).
+    if shutil.which(source):
+        return
+    raise ValidationError(f"node {node_id!r}: source {source!r} not found")
+
+
+def _check_operator_source(node_id, op_id, source, working_dir: Path | None) -> None:
+    if isinstance(source, (PythonSource, SharedLibrarySource)):
+        src = source.source
+        if "://" in src:
+            return
+        path = Path(src)
+        if working_dir and not path.is_absolute():
+            path = working_dir / path
+        if not path.exists():
+            raise ValidationError(
+                f"operator {node_id}/{op_id}: source {src!r} not found"
+            )
+        if isinstance(source, PythonSource) and path.suffix != ".py":
+            raise ValidationError(
+                f"operator {node_id}/{op_id}: python source must be a .py file"
+            )
+    elif isinstance(source, JaxSource):
+        mod, _fn = source.split()
+        if mod.endswith(".py"):
+            path = Path(mod)
+            if working_dir and not path.is_absolute():
+                path = working_dir / path
+            if not path.exists():
+                raise ValidationError(
+                    f"operator {node_id}/{op_id}: jax source file {mod!r} not found"
+                )
+        # module-path sources are resolved at spawn time (import may require
+        # the node's env); nothing to check statically.

@@ -1,0 +1,64 @@
+"""Mermaid flowchart rendering of a dataflow.
+
+Reference parity: libraries/core/src/descriptor/visualize.rs:9-60.
+"""
+
+from __future__ import annotations
+
+from dora_tpu.core.config import TimerMapping, UserMapping
+from dora_tpu.core.descriptor import CustomNode, Descriptor, JaxSource, RuntimeNode
+
+
+def visualize_as_mermaid(descriptor: Descriptor) -> str:
+    lines = ["flowchart TB"]
+
+    timers: set[TimerMapping] = set()
+
+    for node in descriptor.nodes:
+        if isinstance(node.kind, RuntimeNode):
+            tpu = any(isinstance(op.source, JaxSource) for op in node.kind.operators)
+            label = "tpu-runtime" if tpu else "runtime"
+            lines.append(f"subgraph {node.id} [\"{node.id} ({label})\"]")
+            for op in node.kind.operators:
+                lines.append(f"  {node.id}/{op.id}[\"{op.name or op.id}\"]")
+            lines.append("end")
+        else:
+            assert isinstance(node.kind, CustomNode)
+            suffix = " (dynamic)" if node.kind.is_dynamic else ""
+            lines.append(f"  {node.id}[\"{node.name or node.id}{suffix}\"]")
+
+    for node in descriptor.nodes:
+        for input_id, inp in node.inputs.items():
+            m = inp.mapping
+            target = _input_target(node, input_id)
+            if isinstance(m, TimerMapping):
+                timers.add(m)
+                lines.append(f"  {_timer_node_id(m)} -- {input_id} --> {target}")
+            else:
+                assert isinstance(m, UserMapping)
+                src = descriptor.node(m.source)
+                source_ref = _output_source(src, str(m.output))
+                lines.append(f"  {source_ref} -- {m.output} as {input_id} --> {target}")
+
+    for t in sorted(timers, key=lambda t: t.interval_ns):
+        lines.insert(1, f"  {_timer_node_id(t)}[\\{t}/]")
+
+    return "\n".join(lines) + "\n"
+
+
+def _timer_node_id(t: TimerMapping) -> str:
+    return f"dora_timer_{t.interval_ns}"
+
+
+def _input_target(node, input_id: str) -> str:
+    if isinstance(node.kind, RuntimeNode) and "/" in input_id:
+        op, _, _rest = input_id.partition("/")
+        return f"{node.id}/{op}"
+    return str(node.id)
+
+
+def _output_source(node, output_id: str) -> str:
+    if isinstance(node.kind, RuntimeNode) and "/" in output_id:
+        op, _, _rest = output_id.partition("/")
+        return f"{node.id}/{op}"
+    return str(node.id)

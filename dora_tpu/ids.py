@@ -1,0 +1,98 @@
+"""Typed identifiers for the dataflow graph.
+
+Reference parity: dora-core newtypes NodeId/OperatorId/DataId
+(libraries/core/src/config.rs:16-128). In Python we model them as interned
+``str`` subclasses so they serialize transparently (YAML/msgpack/JSON) while
+still being distinct types for static checking, plus composite ids as
+NamedTuples.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+_ID_RE = re.compile(r"^[a-zA-Z0-9_.\-]+$")
+
+
+class _Id(str):
+    __slots__ = ()
+
+    def __new__(cls, value: str):
+        if not value:
+            raise ValueError(f"{cls.__name__} must be non-empty")
+        if "/" in value:
+            raise ValueError(f"{cls.__name__} may not contain '/': {value!r}")
+        return super().__new__(cls, value)
+
+    def __repr__(self) -> str:  # NodeId('camera')
+        return f"{type(self).__name__}({str.__repr__(self)})"
+
+
+class NodeId(_Id):
+    """Identifier of one node in a dataflow."""
+
+
+class OperatorId(_Id):
+    """Identifier of one operator hosted inside a runtime node."""
+
+
+class DataId(str):
+    """Identifier of one output (or input slot) of a node.
+
+    Unlike NodeId/OperatorId this may contain ``/``: runtime nodes namespace
+    their operators' streams as ``<operator>/<output>``.
+    """
+
+    __slots__ = ()
+
+    def __new__(cls, value: str):
+        if not value:
+            raise ValueError("DataId must be non-empty")
+        if value.startswith("/") or value.endswith("/"):
+            raise ValueError(f"DataId may not start/end with '/': {value!r}")
+        return super().__new__(cls, value)
+
+    def __repr__(self) -> str:
+        return f"DataId({str.__repr__(self)})"
+
+
+class DataflowId(str):
+    """UUID of one running dataflow instance."""
+
+    __slots__ = ()
+
+
+class OutputId(NamedTuple):
+    """(node, output) — the global name of a produced stream."""
+
+    node: NodeId
+    output: DataId
+
+    def __str__(self) -> str:
+        return f"{self.node}/{self.output}"
+
+    @classmethod
+    def parse(cls, s: str) -> "OutputId":
+        node, sep, output = s.partition("/")
+        if not sep or not node or not output:
+            raise ValueError(f"expected '<node>/<output>', got {s!r}")
+        return cls(NodeId(node), DataId(output))  # output may itself contain '/'
+
+
+class InputId(NamedTuple):
+    """(node, input) — the global name of a consumed slot."""
+
+    node: NodeId
+    input: DataId
+
+    def __str__(self) -> str:
+        return f"{self.node}/{self.input}"
+
+
+def validate_id(value: str, what: str = "id") -> str:
+    if not _ID_RE.match(value):
+        raise ValueError(
+            f"invalid {what} {value!r}: only [a-zA-Z0-9_.-] allowed"
+        )
+    return value

@@ -1,0 +1,24 @@
+"""Wire protocol for every channel pair in the system.
+
+Reference parity: the `dora-message` crate (libraries/message) — typed serde
+enums per channel pair, versioned independently of the framework, with a
+compatibility check at node-register time.
+
+Channel pairs (module names match the reference's):
+  * cli_to_coordinator / coordinator_to_cli — control API
+  * coordinator_to_daemon / daemon_to_coordinator — cluster management
+  * daemon_to_daemon — inter-machine data forwarding
+  * node_to_daemon / daemon_to_node — the data-plane hot path
+
+Encoding: msgpack with a tagged-union envelope (see serde.py). Every
+top-level message travels as ``Timestamped`` (HLC envelope).
+"""
+
+from dora_tpu.message.serde import (  # noqa: F401
+    Timestamped,
+    decode,
+    decode_timestamped,
+    encode,
+    encode_timestamped,
+    message,
+)

@@ -1,0 +1,194 @@
+"""Wire types shared by several channel pairs.
+
+Reference parity: libraries/message/src/common.rs (DataMessage, DropToken,
+LogMessage, NodeError{GraceDuration,Cascading,Other}) and metadata.rs
+(Metadata / parameters / OTel context).
+
+Data-plane design difference (TPU-first): instead of the reference's
+hand-rolled ArrowTypeInfo buffer-offset table (metadata.rs:51-130) we carry
+payloads in standard **Arrow IPC stream format**, which pyarrow and Arrow C++
+read zero-copy straight out of a mapped shared-memory region, and which keeps
+the wire format language-neutral for the native tier.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any
+
+from dora_tpu.message.serde import message
+
+# ---------------------------------------------------------------------------
+# Payloads
+# ---------------------------------------------------------------------------
+
+#: Encodings for the payload of an Input event / SendMessage.
+ENCODING_ARROW_IPC = "arrow-ipc"  # Arrow IPC stream, read zero-copy
+ENCODING_RAW = "raw"  # untyped bytes
+
+
+@message
+class TypeInfo:
+    """How to interpret the payload bytes."""
+
+    encoding: str  # ENCODING_*
+    len: int
+
+
+@message
+class Metadata:
+    """Per-message metadata: payload typing + user/framework parameters.
+
+    ``parameters`` carries user keys plus framework keys such as
+    ``open_telemetry_context`` (trace propagation, see dora_tpu.telemetry).
+    """
+
+    type_info: TypeInfo
+    parameters: dict[str, Any]
+
+    OTEL_CTX = "open_telemetry_context"
+
+    def otel_context(self) -> str:
+        return str(self.parameters.get(self.OTEL_CTX, ""))
+
+
+def new_drop_token() -> str:
+    """Time-ordered unique token tracking shared-memory buffer lifetime
+    (reference: DropToken UUIDv7, common.rs:175-184)."""
+    ms = time.time_ns() // 1_000_000
+    b = bytearray(ms.to_bytes(6, "big") + os.urandom(10))
+    b[6] = (b[6] & 0x0F) | 0x70
+    b[8] = (b[8] & 0x3F) | 0x80
+    return str(uuid.UUID(bytes=bytes(b)))
+
+
+@message
+class InlineData:
+    """Payload small enough to travel inline through the daemon channel."""
+
+    data: bytes
+
+
+@message
+class SharedMemoryData:
+    """Payload living in a shared-memory region; receivers map it read-only
+    and acknowledge via ``drop_token`` so the sender can reuse the region."""
+
+    shmem_id: str
+    len: int
+    drop_token: str
+
+
+DataMessage = InlineData | SharedMemoryData
+
+
+def data_message_len(data: "DataMessage | None") -> int:
+    if data is None:
+        return 0
+    if isinstance(data, InlineData):
+        return len(data.data)
+    return data.len
+
+
+# ---------------------------------------------------------------------------
+# Node results / errors
+# ---------------------------------------------------------------------------
+
+
+@message
+class NodeExitStatus:
+    """How a node process ended: success, exit code, or signal."""
+
+    success: bool
+    code: int | None = None
+    signal: int | None = None
+    error: str | None = None
+
+
+@message
+class NodeErrorCause:
+    """Classification of a node failure.
+
+    kind: "grace_duration" (killed after stop grace period) |
+          "cascading" (failed because `caused_by_node` failed first) |
+          "other" (own failure; `stderr` holds the last lines).
+    """
+
+    kind: str
+    caused_by_node: str | None = None
+    stderr: str | None = None
+
+
+@message
+class NodeError:
+    exit_status: NodeExitStatus
+    cause: NodeErrorCause
+
+    def __str__(self) -> str:
+        s = self.exit_status
+        how = (
+            "was killed after the stop grace period"
+            if self.cause.kind == "grace_duration"
+            else f"failed because node {self.cause.caused_by_node!r} failed"
+            if self.cause.kind == "cascading"
+            else f"exited with code {s.code}"
+            if s.code is not None
+            else f"was killed by signal {s.signal}"
+            if s.signal is not None
+            else f"failed: {s.error}"
+        )
+        msg = f"node {how}"
+        if self.cause.stderr:
+            msg += f"\n  last stderr:\n    " + "\n    ".join(
+                self.cause.stderr.splitlines()
+            )
+        return msg
+
+
+@message
+class NodeResult:
+    """Success or failure of one node of a finished dataflow."""
+
+    error: NodeError | None = None
+
+
+@message
+class DataflowResult:
+    uuid: str
+    node_results: dict[str, NodeResult]  # node_id -> result
+
+    def is_ok(self) -> bool:
+        return all(r.error is None for r in self.node_results.values())
+
+    def errors(self) -> list[tuple[str, NodeError]]:
+        return [
+            (nid, r.error) for nid, r in sorted(self.node_results.items()) if r.error
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Logging
+# ---------------------------------------------------------------------------
+
+LOG_LEVELS = ("trace", "debug", "info", "warn", "error")
+
+
+@message
+class LogMessage:
+    """A log line traveling daemon -> coordinator -> CLI subscribers."""
+
+    dataflow_id: str
+    level: str
+    message: str
+    node_id: str | None = None
+    target: str | None = None
+    machine_id: str | None = None
+
+
+def log_level_at_least(level: str, minimum: str) -> bool:
+    try:
+        return LOG_LEVELS.index(level) >= LOG_LEVELS.index(minimum)
+    except ValueError:
+        return True

@@ -1,0 +1,281 @@
+"""Control-plane messages: CLI <-> coordinator <-> daemon, daemon <-> daemon.
+
+Reference parity: libraries/message/src/{cli_to_coordinator,
+coordinator_to_cli, coordinator_to_daemon, daemon_to_coordinator,
+daemon_to_daemon}.rs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dora_tpu.message.common import DataflowResult, LogMessage, Metadata
+from dora_tpu.message.serde import message
+
+# ---------------------------------------------------------------------------
+# CLI -> coordinator (ControlRequest)
+# ---------------------------------------------------------------------------
+
+
+@message
+class Start:
+    dataflow: dict[str, Any]  # raw descriptor
+    name: str | None = None
+    local_working_dir: str | None = None
+    uv: bool = False
+
+
+@message
+class Check:
+    dataflow_uuid: str
+
+
+@message
+class Reload:
+    dataflow_id: str
+    node_id: str
+    operator_id: str | None = None
+
+
+@message
+class Stop:
+    dataflow_uuid: str
+    grace_duration_s: float | None = None
+
+
+@message
+class StopByName:
+    name: str
+    grace_duration_s: float | None = None
+
+
+@message
+class Logs:
+    uuid: str | None
+    name: str | None
+    node: str
+
+
+@message
+class ListDataflows:
+    pass
+
+
+@message
+class DaemonConnected:
+    pass
+
+
+@message
+class ConnectedMachines:
+    pass
+
+
+@message
+class LogSubscribe:
+    """Turn this control connection into a live log stream for a dataflow."""
+
+    dataflow_id: str
+    level: str = "info"
+
+
+@message
+class Destroy:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# coordinator -> CLI (ControlRequestReply)
+# ---------------------------------------------------------------------------
+
+
+@message
+class Error:
+    message: str
+
+
+@message
+class CoordinatorStopped:
+    pass
+
+
+@message
+class DataflowStarted:
+    uuid: str
+
+
+@message
+class DataflowReloaded:
+    uuid: str
+
+
+@message
+class DataflowStopped:
+    uuid: str
+    result: DataflowResult
+
+
+@message
+class DataflowSpawnResult:
+    uuid: str
+    error: str | None = None
+
+
+@message
+class DataflowListEntry:
+    uuid: str
+    name: str | None
+
+
+@message
+class DataflowList:
+    dataflows: list[DataflowListEntry]
+
+
+@message
+class LogsReply:
+    logs: bytes
+
+
+@message
+class DaemonConnectedReply:
+    connected: bool
+
+
+@message
+class ConnectedMachinesReply:
+    machines: list[str]
+
+
+@message
+class DestroyOk:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# coordinator -> daemon (DaemonCoordinatorEvent)
+# ---------------------------------------------------------------------------
+
+
+@message
+class RegisterDaemonReply:
+    error: str | None = None
+
+
+@message
+class SpawnDataflowNodes:
+    dataflow_id: str
+    working_dir: str
+    nodes: list[str]  # node ids this machine runs
+    dataflow_descriptor: dict[str, Any]
+    spawn_nodes: list[str]  # non-dynamic subset to actually spawn
+    machine_listen_ports: dict[str, str]  # machine_id -> "host:port"
+    uv: bool = False
+
+
+@message
+class AllNodesReady:
+    """Coordinator broadcast: every machine's nodes subscribed — release the
+    start barrier."""
+
+    dataflow_id: str
+    exited_before_subscribe: list[str]
+
+
+@message
+class StopDataflow:
+    dataflow_id: str
+    grace_duration_s: float | None = None
+
+
+@message
+class ReloadDataflow:
+    dataflow_id: str
+    node_id: str
+    operator_id: str | None = None
+
+
+@message
+class LogsRequest:
+    dataflow_id: str
+    node_id: str
+
+
+@message
+class Heartbeat:
+    pass
+
+
+@message
+class DestroyDaemon:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# daemon -> coordinator
+# ---------------------------------------------------------------------------
+
+
+@message
+class RegisterDaemon:
+    machine_id: str
+    protocol_version: str
+    listen_port: int  # inter-daemon data port
+
+
+@message
+class ReadyOnMachine:
+    """All this machine's nodes of a dataflow subscribed (or some exited
+    before subscribing — the barrier poison case)."""
+
+    dataflow_id: str
+    exited_before_subscribe: list[str]
+
+
+@message
+class AllNodesFinished:
+    dataflow_id: str
+    result: DataflowResult
+
+
+@message
+class DaemonHeartbeat:
+    pass
+
+
+@message
+class DaemonLog:
+    log: LogMessage
+
+
+@message
+class LogsReplyFromDaemon:
+    logs: bytes
+
+
+@message
+class SpawnDataflowResult:
+    dataflow_id: str
+    error: str | None = None
+
+
+# ---------------------------------------------------------------------------
+# daemon -> daemon (InterDaemonEvent)
+# ---------------------------------------------------------------------------
+
+
+@message
+class InterDaemonOutput:
+    """A node output forwarded to another machine (payload always inline —
+    shared memory never crosses machines)."""
+
+    dataflow_id: str
+    output_id: str
+    metadata: Metadata
+    data: bytes | None
+
+
+@message
+class InterDaemonInputsClosed:
+    dataflow_id: str
+    inputs: list[str]  # "<node>/<input>"

@@ -1,0 +1,125 @@
+"""daemon -> node replies and events.
+
+Reference parity: libraries/message/src/daemon_to_node.rs — DaemonReply,
+NodeEvent{Stop,Reload,Input,InputClosed,AllInputsClosed}, NodeConfig with
+the selectable transport (DaemonCommunication{Shmem,Tcp,UnixDomain}).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dora_tpu.message.common import Metadata
+from dora_tpu.message.serde import Timestamped, message
+
+# ---------------------------------------------------------------------------
+# Replies
+# ---------------------------------------------------------------------------
+
+
+@message
+class ReplyResult:
+    """Generic ok/error reply."""
+
+    error: str | None = None
+
+
+@message
+class NextEvents:
+    """Reply to NextEvent: zero or more timestamped NodeEvents (empty list
+    means the stream is closed)."""
+
+    events: list[Timestamped]
+
+
+@message
+class NodeConfigReply:
+    error: str | None = None
+    node_config: Any = None  # NodeConfig
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+
+@message
+class Stop:
+    pass
+
+
+@message
+class Reload:
+    """Hot-reload request for an operator (source changed on disk)."""
+
+    operator_id: str | None = None
+
+
+@message
+class Input:
+    id: str  # input DataId (namespaced "<op>/<input>" inside runtime nodes)
+    metadata: Metadata
+    data: Any  # DataMessage | None
+
+
+@message
+class InputClosed:
+    id: str
+
+
+@message
+class AllInputsClosed:
+    pass
+
+
+NodeEvent = Stop | Reload | Input | InputClosed | AllInputsClosed
+
+
+# ---------------------------------------------------------------------------
+# Node bootstrap config
+# ---------------------------------------------------------------------------
+
+
+@message
+class TcpCommunication:
+    socket_addr: str  # "host:port"
+
+
+@message
+class UnixDomainCommunication:
+    socket_file: str
+
+
+@message
+class ShmemCommunication:
+    """Four shared-memory request-reply regions, exactly like the reference
+    (daemon_to_node.rs:13-44): control, events, drop, events-close-signal."""
+
+    control_region_id: str
+    events_region_id: str
+    drop_region_id: str
+    events_close_region_id: str
+
+
+DaemonCommunication = TcpCommunication | UnixDomainCommunication | ShmemCommunication
+
+
+@message
+class RunConfig:
+    """The node's IO signature: input id -> queue size, plus output ids."""
+
+    inputs: dict[str, int]
+    outputs: list[str]
+
+
+@message
+class NodeConfig:
+    """Injected into node processes via the DORA_NODE_CONFIG env var (YAML),
+    or fetched over TCP by dynamic nodes."""
+
+    dataflow_id: str
+    node_id: str
+    run_config: RunConfig
+    daemon_communication: Any  # DaemonCommunication
+    dataflow_descriptor: dict[str, Any]
+    dynamic: bool = False

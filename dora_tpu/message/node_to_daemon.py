@@ -1,0 +1,87 @@
+"""node -> daemon requests (the data-plane hot path).
+
+Reference parity: libraries/message/src/node_to_daemon.rs:9-68 — including
+the reply-expectation matrix: SendMessage and ReportDropTokens expect **no**
+reply (fire-and-forget keeps the send path one-way), everything else gets a
+DaemonReply.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dora_tpu.message.common import Metadata
+from dora_tpu.message.serde import message
+
+
+@message
+class Register:
+    """First message on every node channel; daemon checks protocol version
+    compatibility and replies Result."""
+
+    dataflow_id: str
+    node_id: str
+    protocol_version: str
+
+
+@message
+class Subscribe:
+    """Subscribe to the event stream. The reply is withheld until every node
+    of the dataflow has subscribed (cluster-wide start barrier)."""
+
+
+@message
+class SubscribeDrop:
+    """Subscribe to the drop stream (notifications that our shared-memory
+    regions are no longer referenced by any receiver)."""
+
+
+@message
+class SendMessage:
+    """Publish one output. No reply expected."""
+
+    output_id: str
+    metadata: Metadata
+    data: Any  # DataMessage | None
+
+
+@message
+class CloseOutputs:
+    outputs: list[str]
+
+
+@message
+class OutputsDone:
+    """All outputs closed; sent on node drop."""
+
+
+@message
+class NextEvent:
+    """Blocking poll for the next batch of events; piggybacks acknowledged
+    drop tokens from events the node finished reading."""
+
+    drop_tokens: list[str]
+
+
+@message
+class ReportDropTokens:
+    """Out-of-band drop-token ack (used by the drop stream). No reply."""
+
+    drop_tokens: list[str]
+
+
+@message
+class EventStreamDropped:
+    """Node-side event stream was closed; daemon stops queueing inputs."""
+
+
+@message
+class NodeConfigRequest:
+    """Dynamic-node bootstrap: sent to the daemon's local listen port to
+    fetch the NodeConfig for an externally-started node."""
+
+    node_id: str
+
+
+def expects_reply(request: Any) -> bool:
+    return not isinstance(request, (SendMessage, ReportDropTokens))

@@ -1,0 +1,112 @@
+"""Generic dataclass <-> msgpack tagged-union serialization.
+
+Every protocol message is a frozen dataclass registered under its class name
+with the ``@message`` decorator. On the wire a message is
+``{"t": <class name>, "f": {<field>: <value>, ...}}`` — recursively for
+nested messages — packed with msgpack (bytes pass through zero-copy).
+
+This replaces the reference's serde derive + bincode/serde_json
+(libraries/message): one codec, self-describing, language-portable (the C++
+native tier uses the same layout via its own msgpack writer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Type, TypeVar
+
+import msgpack
+
+from dora_tpu.clock import Timestamp
+
+_REGISTRY: dict[str, type] = {}
+
+T = TypeVar("T")
+
+
+def message(cls: Type[T]) -> Type[T]:
+    """Class decorator: freeze as dataclass and register for the wire."""
+    cls = dataclasses.dataclass(frozen=True)(cls)
+    name = cls.__name__
+    if name in _REGISTRY and _REGISTRY[name] is not cls:
+        raise RuntimeError(f"duplicate message type name: {name}")
+    _REGISTRY[name] = cls
+    return cls
+
+
+def _to_wire(value: Any) -> Any:
+    if value is None or isinstance(value, (bool, int, float, str, bytes, bytearray)):
+        return value
+    if isinstance(value, memoryview):
+        return bytes(value)
+    if isinstance(value, Timestamp):
+        return {"t": "@ts", "f": list(value.to_wire())}
+    if dataclasses.is_dataclass(value) and type(value).__name__ in _REGISTRY:
+        return {
+            "t": type(value).__name__,
+            "f": {
+                f.name: _to_wire(getattr(value, f.name))
+                for f in dataclasses.fields(value)
+            },
+        }
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [_to_wire(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _to_wire(v) for k, v in value.items()}
+    raise TypeError(f"cannot serialize {type(value).__name__}: {value!r}")
+
+
+def _from_wire(value: Any) -> Any:
+    if isinstance(value, dict):
+        tag = value.get("t")
+        if tag == "@ts":
+            return Timestamp.from_wire(value["f"])
+        if tag is not None and tag in _REGISTRY and "f" in value:
+            cls = _REGISTRY[tag]
+            fields = {k: _from_wire(v) for k, v in value["f"].items()}
+            known = {f.name for f in dataclasses.fields(cls)}
+            # Forward compatibility: ignore unknown fields.
+            return cls(**{k: v for k, v in fields.items() if k in known})
+        return {k: _from_wire(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_from_wire(v) for v in value]
+    return value
+
+
+def encode(msg: Any) -> bytes:
+    return msgpack.packb(_to_wire(msg), use_bin_type=True)
+
+
+def decode(data: bytes | memoryview) -> Any:
+    return _from_wire(msgpack.unpackb(data, raw=False, strict_map_key=False))
+
+
+# ---------------------------------------------------------------------------
+# HLC envelope
+# ---------------------------------------------------------------------------
+
+
+@message
+class Timestamped:
+    """HLC envelope: every top-level protocol message travels inside one."""
+
+    inner: Any
+    timestamp: Timestamp
+
+
+def encode_timestamped(msg: Any, clock) -> bytes:
+    return encode(Timestamped(inner=msg, timestamp=clock.new_timestamp()))
+
+
+def decode_timestamped(data: bytes | memoryview, clock=None) -> Timestamped:
+    msg = decode(data)
+    if not isinstance(msg, Timestamped):
+        raise ValueError(f"expected Timestamped envelope, got {type(msg).__name__}")
+    if clock is not None:
+        clock.update_with_timestamp(msg.timestamp)
+    return msg
+
+
+def typing_hints(cls) -> dict[str, Any]:  # pragma: no cover - debug helper
+    return typing.get_type_hints(cls)

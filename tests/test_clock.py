@@ -1,0 +1,57 @@
+import threading
+
+from dora_tpu.clock import HLC, Timestamp
+
+
+def test_monotonic():
+    c = HLC()
+    prev = c.new_timestamp()
+    for _ in range(10_000):
+        t = c.new_timestamp()
+        assert t > prev
+        prev = t
+
+
+def test_update_with_remote_advances():
+    a, b = HLC("a"), HLC("b")
+    t_a = a.new_timestamp()
+    # Remote timestamp far in the future: local clock must move past it.
+    future = Timestamp(t_a.time + (1 << 40), "b")
+    a.update_with_timestamp(future)
+    assert a.new_timestamp().time > future.time
+
+
+def test_update_with_past_is_noop_for_ordering():
+    a = HLC("a")
+    t1 = a.new_timestamp()
+    a.update_with_timestamp(Timestamp(0, "b"))
+    assert a.new_timestamp() > t1
+
+
+def test_wire_roundtrip():
+    c = HLC()
+    t = c.new_timestamp()
+    assert Timestamp.from_wire(t.to_wire()) == t
+
+
+def test_thread_safety_unique_and_ordered():
+    c = HLC()
+    out: list[list[Timestamp]] = [[] for _ in range(4)]
+
+    def worker(i):
+        for _ in range(2000):
+            out[i].append(c.new_timestamp())
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    all_ts = [t for lst in out for t in lst]
+    assert len(set(all_ts)) == len(all_ts)  # globally unique
+    for lst in out:
+        assert lst == sorted(lst)  # per-thread monotonic
+
+
+def test_physical_logical_split():
+    t = Timestamp((123 << 16) | 7, "x")
+    assert t.physical_ns == 123
+    assert t.logical == 7

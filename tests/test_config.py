@@ -1,0 +1,102 @@
+import pytest
+
+from dora_tpu.core.config import (
+    CommunicationConfig,
+    Input,
+    TimerMapping,
+    UserMapping,
+    expand_env,
+    parse_input_mapping,
+)
+
+
+class TestInputMapping:
+    def test_user_mapping(self):
+        m = parse_input_mapping("camera/image")
+        assert isinstance(m, UserMapping)
+        assert m.source == "camera"
+        assert m.output == "image"
+        assert str(m) == "camera/image"
+
+    @pytest.mark.parametrize(
+        "s,ns",
+        [
+            ("dora/timer/millis/100", 100_000_000),
+            ("dora/timer/secs/2", 2_000_000_000),
+            ("dora/timer/micros/500", 500_000),
+            ("dora/timer/nanos/42", 42),
+        ],
+    )
+    def test_timer_mapping(self, s, ns):
+        m = parse_input_mapping(s)
+        assert isinstance(m, TimerMapping)
+        assert m.interval_ns == ns
+        assert str(m) == s
+
+    def test_timer_canonicalizes_units(self):
+        assert str(parse_input_mapping("dora/timer/millis/1000")) == "dora/timer/secs/1"
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "dora/timer/hours/1",
+            "dora/timer/millis/abc",
+            "dora/timer/millis/0",
+            "dora/timer/millis/-5",
+            "dora/unknown",
+            "justonepart",
+            "/x",
+            "x/",
+        ],
+    )
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_input_mapping(bad)
+
+
+class TestInput:
+    def test_string_form(self):
+        i = Input.parse("cam/img")
+        assert i.queue_size == 10
+        assert i.to_dict() == "cam/img"
+
+    def test_mapping_form(self):
+        i = Input.parse({"source": "cam/img", "queue_size": 1})
+        assert i.queue_size == 1
+        assert i.to_dict() == {"source": "cam/img", "queue_size": 1}
+
+    def test_rejects_bad_queue_size(self):
+        for qs in (0, -1, "two"):
+            with pytest.raises(ValueError):
+                Input.parse({"source": "a/b", "queue_size": qs})
+
+    def test_rejects_unknown_keys(self):
+        with pytest.raises(ValueError):
+            Input.parse({"source": "a/b", "bogus": 1})
+
+
+class TestCommunication:
+    def test_default(self):
+        c = CommunicationConfig.parse(None)
+        assert c.local.kind == "tcp"
+        assert c.remote == "tcp"
+
+    def test_shmem(self):
+        c = CommunicationConfig.parse({"local": "shmem"})
+        assert c.local.kind == "shmem"
+
+    def test_reference_compat_keys(self):
+        c = CommunicationConfig.parse({"_unstable_local": "uds"})
+        assert c.local.kind == "uds"
+
+    def test_bad_kind(self):
+        with pytest.raises(ValueError):
+            CommunicationConfig.parse({"local": "carrier-pigeon"})
+
+
+def test_expand_env():
+    env = {"HOME_X": "/home/u", "N": "3"}
+    assert expand_env("$HOME_X/bin", env) == "/home/u/bin"
+    assert expand_env("${N} nodes", env) == "3 nodes"
+    assert expand_env("$MISSING stays", env) == "$MISSING stays"
+    assert expand_env(42, env) == 42

@@ -1,0 +1,275 @@
+import textwrap
+
+import pytest
+import yaml
+
+from dora_tpu.core.descriptor import (
+    CustomNode,
+    Descriptor,
+    JaxSource,
+    PythonSource,
+    RuntimeNode,
+    SharedLibrarySource,
+)
+from dora_tpu.core.validate import ValidationError, check_dataflow
+from dora_tpu.ids import OutputId
+
+VLM_YAML = textwrap.dedent(
+    """
+    nodes:
+      - id: camera
+        path: camera.py
+        inputs:
+          tick: dora/timer/millis/20
+        outputs: [image]
+      - id: vlm
+        operators:
+          - id: qwenvl
+            jax: dora_tpu.models.qwen_vl:make_operator
+            inputs:
+              image:
+                source: camera/image
+                queue_size: 1
+              tick: dora/timer/millis/100
+            outputs: [text]
+      - id: plot
+        path: plot.py
+        inputs:
+          image: camera/image
+          text: vlm/qwenvl/text
+    """
+)
+
+
+def parse(y: str) -> Descriptor:
+    return Descriptor.parse(yaml.safe_load(y))
+
+
+class TestParse:
+    def test_vlm_graph(self):
+        d = parse(VLM_YAML)
+        assert len(d.nodes) == 3
+        cam = d.node("camera")
+        assert isinstance(cam.kind, CustomNode)
+        assert cam.kind.source == "camera.py"
+        assert set(cam.outputs) == {"image"}
+
+        vlm = d.node("vlm")
+        assert isinstance(vlm.kind, RuntimeNode)
+        op = vlm.kind.operators[0]
+        assert isinstance(op.source, JaxSource)
+        assert op.source.split() == ("dora_tpu.models.qwen_vl", "make_operator")
+        assert vlm.inputs["qwenvl/image"].queue_size == 1
+        assert set(vlm.outputs) == {"qwenvl/text"}
+
+    def test_single_operator_shorthand_namespaces_outputs(self, tmp_path):
+        d = parse(
+            """
+            nodes:
+              - id: det
+                operator:
+                  python: det.py
+                  inputs: {img: cam/image}
+                  outputs: [bbox]
+              - id: cam
+                path: cam.py
+                outputs: [image]
+            """
+        )
+        det = d.node("det")
+        assert isinstance(det.kind, RuntimeNode)
+        assert det.kind.operators[0].id == "op"
+        assert set(det.outputs) == {"op/bbox"}
+        assert OutputId.parse("det/op/bbox".replace("det/", "", 1))  # sanity
+
+    def test_custom_node_compat(self):
+        d = parse(
+            """
+            nodes:
+              - id: n
+                custom:
+                  source: ./bin/node
+                  args: --flag
+                  envs: {A: "1"}
+                  outputs: [o]
+            """
+        )
+        n = d.node("n")
+        assert isinstance(n.kind, CustomNode)
+        assert n.kind.args == "--flag"
+        assert n.env["A"] == "1"
+
+    def test_shared_library_operator(self):
+        d = parse(
+            """
+            nodes:
+              - id: n
+                operators:
+                  - id: o
+                    shared-library: ./libop.so
+            """
+        )
+        op = d.node("n").kind.operators[0]
+        assert isinstance(op.source, SharedLibrarySource)
+
+    def test_dynamic_node(self):
+        d = parse(
+            """
+            nodes:
+              - id: ext
+                path: dynamic
+                outputs: [x]
+            """
+        )
+        assert d.node("ext").kind.is_dynamic
+
+    def test_deploy_machine(self):
+        d = parse(
+            """
+            nodes:
+              - id: a
+                path: a
+                deploy: {machine: gpu-1}
+              - id: b
+                path: b
+            """
+        )
+        assert d.node("a").deploy.machine == "gpu-1"
+        assert d.node("b").deploy.machine is None
+        assert d.machines() == {"gpu-1", ""}
+
+    def test_global_env_merged(self):
+        d = parse(
+            """
+            env: {SHARED: "yes"}
+            nodes:
+              - id: a
+                path: a
+                env: {OWN: "1"}
+            """
+        )
+        assert d.node("a").env == {"SHARED": "yes", "OWN": "1"}
+
+
+class TestParseErrors:
+    @pytest.mark.parametrize(
+        "y,match",
+        [
+            ("nodes: []", "no nodes"),
+            ("{}", "no nodes"),
+            ("bogus: 1\nnodes: [{id: a, path: p}]", "unknown top-level"),
+            ("nodes: [{path: p}]", "missing 'id'"),
+            ("nodes: [{id: a}]", "exactly one of"),
+            ("nodes: [{id: a, path: p, operators: []}]", "exactly one of"),
+            ("nodes: [{id: a, path: p}, {id: a, path: q}]", "duplicate node ids"),
+            ("nodes: [{id: a, operators: []}]", "empty 'operators'"),
+            (
+                "nodes: [{id: a, operators: [{id: o, python: p, jax: q}]}]",
+                "exactly one of",
+            ),
+        ],
+    )
+    def test_bad_yaml(self, y, match):
+        with pytest.raises(ValueError, match=match):
+            parse(y)
+
+
+class TestValidate:
+    def test_missing_source_file(self, tmp_path):
+        d = parse("nodes: [{id: a, path: ./nope.py, outputs: [o]}]")
+        with pytest.raises(ValidationError, match="not found"):
+            check_dataflow(d, tmp_path)
+
+    def test_source_on_path_accepted(self, tmp_path):
+        d = parse("nodes: [{id: a, path: python, outputs: [o]}]")
+        check_dataflow(d, tmp_path)
+
+    def test_input_refers_to_missing_node(self, tmp_path):
+        d = parse(
+            """
+            nodes:
+              - id: a
+                path: python
+                inputs: {x: ghost/out}
+            """
+        )
+        with pytest.raises(ValidationError, match="does not exist"):
+            check_dataflow(d, tmp_path)
+
+    def test_input_refers_to_missing_output(self, tmp_path):
+        d = parse(
+            """
+            nodes:
+              - id: a
+                path: python
+                outputs: [real]
+              - id: b
+                path: python
+                inputs: {x: a/fake}
+            """
+        )
+        with pytest.raises(ValidationError, match="no.*output"):
+            check_dataflow(d, tmp_path)
+
+    def test_valid_graph_passes(self, tmp_path):
+        (tmp_path / "cam.py").write_text("")
+        d = parse(
+            """
+            nodes:
+              - id: cam
+                path: ./cam.py
+                inputs: {tick: dora/timer/millis/20}
+                outputs: [image]
+              - id: sink
+                path: python
+                inputs: {img: cam/image}
+            """
+        )
+        check_dataflow(d, tmp_path)
+
+    def test_dynamic_source_skips_path_check(self, tmp_path):
+        d = parse("nodes: [{id: a, path: dynamic, outputs: [o]}]")
+        check_dataflow(d, tmp_path)
+
+    def test_jax_module_source_ok_without_file(self, tmp_path):
+        d = parse(
+            """
+            nodes:
+              - id: n
+                operators:
+                  - id: o
+                    jax: some.module:factory
+            """
+        )
+        check_dataflow(d, tmp_path)
+
+    def test_jax_file_source_checked(self, tmp_path):
+        d = parse(
+            """
+            nodes:
+              - id: n
+                operators:
+                  - id: o
+                    jax: ops.py:factory
+            """
+        )
+        with pytest.raises(ValidationError, match="not found"):
+            check_dataflow(d, tmp_path)
+
+
+def test_mermaid_output():
+    d = parse(VLM_YAML)
+    mermaid = d.visualize_as_mermaid()
+    assert mermaid.startswith("flowchart TB")
+    assert "dora/timer/millis/20" in mermaid
+    assert "camera" in mermaid
+    assert "tpu-runtime" in mermaid
+    assert "-- image as image -->" in mermaid
+
+
+def test_dataflow_uuid_v7_time_ordered():
+    from dora_tpu.core.descriptor import new_dataflow_uuid
+
+    a, b = new_dataflow_uuid(), new_dataflow_uuid()
+    assert a != b
+    assert a[14] == "7" and b[14] == "7"  # version nibble

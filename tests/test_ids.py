@@ -1,0 +1,38 @@
+import pytest
+
+from dora_tpu.ids import DataId, NodeId, OperatorId, OutputId
+
+
+def test_ids_are_strings():
+    n = NodeId("camera")
+    assert n == "camera"
+    assert isinstance(n, str)
+    assert repr(n) == "NodeId('camera')"
+
+
+def test_ids_reject_slash_and_empty():
+    with pytest.raises(ValueError):
+        NodeId("a/b")
+    with pytest.raises(ValueError):
+        DataId("")
+    with pytest.raises(ValueError):
+        OperatorId("x/y")
+
+
+def test_output_id_roundtrip():
+    o = OutputId.parse("camera/image")
+    assert o.node == NodeId("camera")
+    assert o.output == DataId("image")
+    assert str(o) == "camera/image"
+    assert OutputId.parse(str(o)) == o
+
+
+def test_output_id_parse_errors():
+    for bad in ("noslash", "a/", "/b", ""):
+        with pytest.raises(ValueError):
+            OutputId.parse(bad)
+
+
+def test_ids_usable_as_dict_keys():
+    d = {NodeId("a"): 1}
+    assert d["a"] == 1  # str interop

@@ -1,0 +1,166 @@
+import pytest
+
+from dora_tpu.clock import HLC, Timestamp
+from dora_tpu.message import decode, decode_timestamped, encode, encode_timestamped
+from dora_tpu.message.common import (
+    DataflowResult,
+    InlineData,
+    Metadata,
+    NodeError,
+    NodeErrorCause,
+    NodeExitStatus,
+    NodeResult,
+    SharedMemoryData,
+    TypeInfo,
+    new_drop_token,
+)
+from dora_tpu.message.daemon_to_node import (
+    Input,
+    NextEvents,
+    NodeConfig,
+    RunConfig,
+    ShmemCommunication,
+    Stop,
+    TcpCommunication,
+)
+from dora_tpu.message.node_to_daemon import (
+    NextEvent,
+    Register,
+    ReportDropTokens,
+    SendMessage,
+    Subscribe,
+    expects_reply,
+)
+from dora_tpu.message.serde import Timestamped
+
+
+def roundtrip(msg):
+    decoded = decode(encode(msg))
+    assert decoded == msg
+    return decoded
+
+
+def test_simple_roundtrip():
+    roundtrip(Register(dataflow_id="df", node_id="n", protocol_version="0.1.0"))
+    roundtrip(Subscribe())
+    roundtrip(Stop())
+
+
+def test_nested_and_bytes_roundtrip():
+    md = Metadata(
+        type_info=TypeInfo(encoding="arrow-ipc", len=5),
+        parameters={"open_telemetry_context": "a:b;", "custom": 7},
+    )
+    msg = SendMessage(output_id="image", metadata=md, data=InlineData(data=b"\x00\x01\xff"))
+    out = roundtrip(msg)
+    assert isinstance(out.data, InlineData)
+    assert out.data.data == b"\x00\x01\xff"
+    assert out.metadata.otel_context() == "a:b;"
+
+
+def test_shared_memory_data():
+    token = new_drop_token()
+    roundtrip(SharedMemoryData(shmem_id="/dora_abc", len=40 << 20, drop_token=token))
+
+
+def test_timestamped_envelope():
+    clock = HLC("sender")
+    receiver = HLC("receiver")
+    raw = encode_timestamped(NextEvent(drop_tokens=["t1"]), clock)
+    env = decode_timestamped(raw, receiver)
+    assert isinstance(env, Timestamped)
+    assert env.inner == NextEvent(drop_tokens=["t1"])
+    assert env.timestamp.id == clock.id
+    # Receiver clock advanced past the sender timestamp.
+    assert receiver.new_timestamp() > env.timestamp
+
+
+def test_events_with_nested_timestamps():
+    clock = HLC()
+    md = Metadata(type_info=TypeInfo(encoding="raw", len=0), parameters={})
+    ev = Timestamped(
+        inner=Input(id="op/img", metadata=md, data=None),
+        timestamp=clock.new_timestamp(),
+    )
+    roundtrip(NextEvents(events=[ev]))
+
+
+def test_node_config_roundtrip():
+    cfg = NodeConfig(
+        dataflow_id="df",
+        node_id="cam",
+        run_config=RunConfig(inputs={"tick": 10}, outputs=["image"]),
+        daemon_communication=TcpCommunication(socket_addr="127.0.0.1:5000"),
+        dataflow_descriptor={"nodes": [{"id": "cam"}]},
+        dynamic=False,
+    )
+    out = roundtrip(cfg)
+    assert isinstance(out.daemon_communication, TcpCommunication)
+
+    cfg2 = NodeConfig(
+        dataflow_id="df",
+        node_id="cam",
+        run_config=RunConfig(inputs={}, outputs=[]),
+        daemon_communication=ShmemCommunication(
+            control_region_id="a", events_region_id="b",
+            drop_region_id="c", events_close_region_id="d",
+        ),
+        dataflow_descriptor={},
+    )
+    assert isinstance(roundtrip(cfg2).daemon_communication, ShmemCommunication)
+
+
+def test_reply_expectation_matrix():
+    md = Metadata(type_info=TypeInfo(encoding="raw", len=0), parameters={})
+    assert not expects_reply(SendMessage(output_id="x", metadata=md, data=None))
+    assert not expects_reply(ReportDropTokens(drop_tokens=[]))
+    assert expects_reply(Subscribe())
+    assert expects_reply(NextEvent(drop_tokens=[]))
+
+
+def test_node_error_formatting():
+    err = NodeError(
+        exit_status=NodeExitStatus(success=False, code=1),
+        cause=NodeErrorCause(kind="other", stderr="boom\nbang"),
+    )
+    s = str(err)
+    assert "exited with code 1" in s
+    assert "boom" in s
+
+    casc = NodeError(
+        exit_status=NodeExitStatus(success=False, signal=9),
+        cause=NodeErrorCause(kind="cascading", caused_by_node="upstream"),
+    )
+    assert "upstream" in str(casc)
+
+
+def test_dataflow_result():
+    r = DataflowResult(
+        uuid="u",
+        node_results={
+            "a": NodeResult(),
+            "b": NodeResult(
+                error=NodeError(
+                    exit_status=NodeExitStatus(success=False, code=2),
+                    cause=NodeErrorCause(kind="other"),
+                )
+            ),
+        },
+    )
+    assert not r.is_ok()
+    assert [n for n, _ in r.errors()] == ["b"]
+    roundtrip(r)
+
+
+def test_forward_compat_ignores_unknown_fields():
+    raw = encode(Subscribe())
+    import msgpack
+
+    obj = msgpack.unpackb(raw)
+    obj["f"]["future_field"] = 123
+    assert decode(msgpack.packb(obj)) == Subscribe()
+
+
+def test_drop_tokens_unique_and_time_ordered():
+    tokens = [new_drop_token() for _ in range(100)]
+    assert len(set(tokens)) == 100
