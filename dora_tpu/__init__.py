@@ -21,12 +21,15 @@ PROTOCOL_VERSION = "0.1.0"
 def __getattr__(name):
     # Lazy re-exports so that `import dora_tpu` stays cheap for CLI tools
     # and subprocess nodes (jax import alone costs ~2s).
-    if name == "Node":
-        from dora_tpu.node.node import Node
+    try:
+        if name == "Node":
+            from dora_tpu.node import Node
 
-        return Node
-    if name == "Descriptor":
-        from dora_tpu.core.descriptor import Descriptor
+            return Node
+        if name == "Descriptor":
+            from dora_tpu.core.descriptor import Descriptor
 
-        return Descriptor
+            return Descriptor
+    except ImportError as e:
+        raise AttributeError(f"cannot import dora_tpu.{name}: {e}") from e
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -254,7 +254,7 @@ class Descriptor:
     def parse(cls, raw: Mapping[str, Any]) -> "Descriptor":
         if not isinstance(raw, Mapping):
             raise ValueError("dataflow descriptor must be a YAML mapping")
-        known = {"nodes", "communication", "_unstable_deploy", "env"}
+        known = {"nodes", "communication", "deploy", "_unstable_deploy", "env"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown top-level keys: {sorted(unknown)}")
@@ -262,7 +262,11 @@ class Descriptor:
         if not nodes_raw:
             raise ValueError("dataflow has no nodes")
         global_env = raw.get("env") or {}
-        nodes = tuple(cls._parse_node(n, global_env) for n in nodes_raw)
+        # Top-level deploy provides per-node defaults (e.g. default machine).
+        default_deploy = Deploy.parse(raw.get("deploy") or raw.get("_unstable_deploy"))
+        nodes = tuple(
+            cls._parse_node(n, global_env, default_deploy) for n in nodes_raw
+        )
         ids = [n.id for n in nodes]
         dupes = {i for i in ids if ids.count(i) > 1}
         if dupes:
@@ -274,7 +278,12 @@ class Descriptor:
         )
 
     @classmethod
-    def _parse_node(cls, value: Mapping[str, Any], global_env: Mapping[str, Any]) -> ResolvedNode:
+    def _parse_node(
+        cls,
+        value: Mapping[str, Any],
+        global_env: Mapping[str, Any],
+        default_deploy: "Deploy | None" = None,
+    ) -> ResolvedNode:
         if "id" not in value:
             raise ValueError(f"node missing 'id': {value!r}")
         node_id = NodeId(str(value["id"]))
@@ -319,12 +328,15 @@ class Descriptor:
             op = OperatorDefinition.parse(value["operator"], default_id=DEFAULT_OPERATOR_ID)
             kind = RuntimeNode(operators=(op,))
 
+        deploy = Deploy.parse(value.get("deploy") or value.get("_unstable_deploy"))
+        if deploy.machine is None and default_deploy is not None:
+            deploy = default_deploy
         return ResolvedNode(
             id=node_id,
             name=value.get("name"),
             description=value.get("description"),
             env=env,
-            deploy=Deploy.parse(value.get("deploy") or value.get("_unstable_deploy")),
+            deploy=deploy,
             kind=kind,
         )
 

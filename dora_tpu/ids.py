@@ -12,7 +12,7 @@ from __future__ import annotations
 import re
 from typing import NamedTuple
 
-_ID_RE = re.compile(r"^[a-zA-Z0-9_.\-]+$")
+_ID_RE = re.compile(r"[a-zA-Z0-9_.\-]+")
 
 
 class _Id(str):
@@ -21,8 +21,10 @@ class _Id(str):
     def __new__(cls, value: str):
         if not value:
             raise ValueError(f"{cls.__name__} must be non-empty")
-        if "/" in value:
-            raise ValueError(f"{cls.__name__} may not contain '/': {value!r}")
+        if not _ID_RE.fullmatch(value):
+            raise ValueError(
+                f"invalid {cls.__name__} {value!r}: only [a-zA-Z0-9_.-] allowed"
+            )
         return super().__new__(cls, value)
 
     def __repr__(self) -> str:  # NodeId('camera')
@@ -49,8 +51,12 @@ class DataId(str):
     def __new__(cls, value: str):
         if not value:
             raise ValueError("DataId must be non-empty")
-        if value.startswith("/") or value.endswith("/"):
-            raise ValueError(f"DataId may not start/end with '/': {value!r}")
+        segments = value.split("/")
+        if not all(_ID_RE.fullmatch(s) for s in segments):
+            raise ValueError(
+                f"invalid DataId {value!r}: '/'-separated segments of "
+                f"[a-zA-Z0-9_.-] required"
+            )
         return super().__new__(cls, value)
 
     def __repr__(self) -> str:
@@ -91,7 +97,7 @@ class InputId(NamedTuple):
 
 
 def validate_id(value: str, what: str = "id") -> str:
-    if not _ID_RE.match(value):
+    if not _ID_RE.fullmatch(value):
         raise ValueError(
             f"invalid {what} {value!r}: only [a-zA-Z0-9_.-] allowed"
         )

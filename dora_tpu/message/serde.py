@@ -53,6 +53,10 @@ def _to_wire(value: Any) -> Any:
     if isinstance(value, (list, tuple, set, frozenset)):
         return [_to_wire(v) for v in value]
     if isinstance(value, dict):
+        if "t" in value:
+            # Escape user dicts that would collide with the tagged-union
+            # envelope (e.g. Metadata.parameters containing a "t" key).
+            return {"t": "@map", "f": [[str(k), _to_wire(v)] for k, v in value.items()]}
         return {str(k): _to_wire(v) for k, v in value.items()}
     raise TypeError(f"cannot serialize {type(value).__name__}: {value!r}")
 
@@ -62,6 +66,8 @@ def _from_wire(value: Any) -> Any:
         tag = value.get("t")
         if tag == "@ts":
             return Timestamp.from_wire(value["f"])
+        if tag == "@map":
+            return {k: _from_wire(v) for k, v in value["f"]}
         if tag is not None and tag in _REGISTRY and "f" in value:
             cls = _REGISTRY[tag]
             fields = {k: _from_wire(v) for k, v in value["f"].items()}

@@ -1,0 +1,256 @@
+"""ctypes bindings to the native C++ shared-memory layer (native/shmem.cpp).
+
+The library is built on demand with g++ (cached next to this file as
+``_native.so``); nodes in other languages link the same C ABI directly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE.parent / "native" / "shmem.cpp"
+_LIB = _HERE / "_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def build_native(force: bool = False) -> Path:
+    """Compile native/shmem.cpp to dora_tpu/_native.so if needed."""
+    if _LIB.exists() and not force:
+        if not _SRC.exists() or _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _LIB
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(_LIB), str(_SRC), "-lrt", "-pthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        build_native()
+        lib = ctypes.CDLL(str(_LIB))
+        # regions
+        lib.dtp_region_create.restype = ctypes.c_void_p
+        lib.dtp_region_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.dtp_region_open.restype = ctypes.c_void_p
+        lib.dtp_region_open.argtypes = [ctypes.c_char_p]
+        lib.dtp_region_ptr.restype = ctypes.c_void_p
+        lib.dtp_region_ptr.argtypes = [ctypes.c_void_p]
+        lib.dtp_region_size.restype = ctypes.c_uint64
+        lib.dtp_region_size.argtypes = [ctypes.c_void_p]
+        lib.dtp_region_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dtp_region_unlink.argtypes = [ctypes.c_char_p]
+        # channels
+        lib.dtp_channel_create.restype = ctypes.c_void_p
+        lib.dtp_channel_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.dtp_channel_open.restype = ctypes.c_void_p
+        lib.dtp_channel_open.argtypes = [ctypes.c_char_p]
+        lib.dtp_channel_capacity.restype = ctypes.c_uint32
+        lib.dtp_channel_capacity.argtypes = [ctypes.c_void_p]
+        lib.dtp_channel_send.restype = ctypes.c_int
+        lib.dtp_channel_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.dtp_channel_recv.restype = ctypes.c_int64
+        lib.dtp_channel_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.dtp_channel_disconnect.argtypes = [ctypes.c_void_p]
+        lib.dtp_channel_is_disconnected.restype = ctypes.c_int
+        lib.dtp_channel_is_disconnected.argtypes = [ctypes.c_void_p]
+        lib.dtp_channel_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+class ShmemError(RuntimeError):
+    pass
+
+
+class Disconnected(ShmemError):
+    pass
+
+
+class ShmemRegion:
+    """A named shared-memory region, zero-copy readable/writable.
+
+    The region object itself implements the buffer protocol (PEP 688) with
+    export counting: take zero-copy views as ``np.frombuffer(region, ...)``
+    or ``memoryview(region)`` — ``close()`` then refuses to unmap while such
+    views are alive (unmapping under a live view is a segfault, not an
+    exception). The ``.buf`` property is for transient access only
+    (``region.buf[0:4] = b"head"``); views derived from a ``.buf`` you hold
+    are not individually tracked.
+    """
+
+    def __init__(self, handle: int, name: str, owner: bool):
+        self._h = handle
+        self.name = name
+        self.owner = owner
+        lib = _load()
+        self.size = lib.dtp_region_size(handle)
+        ptr = lib.dtp_region_ptr(handle)
+        self._carray = (ctypes.c_ubyte * self.size).from_address(ptr)
+        self._exports = 0
+
+    def __buffer__(self, flags) -> memoryview:
+        if not self._h:
+            raise ShmemError(f"shmem region {self.name!r} is closed")
+        self._exports += 1
+        return memoryview(self._carray).cast("B")
+
+    def __release_buffer__(self, view: memoryview) -> None:
+        self._exports -= 1
+        view.release()
+
+    @property
+    def buf(self) -> memoryview:
+        """A fresh transient view; do not store slices of it past close()."""
+        return memoryview(self)
+
+    def __len__(self) -> int:
+        return self.size
+
+    @classmethod
+    def create(cls, name: str, size: int) -> "ShmemRegion":
+        h = _load().dtp_region_create(name.encode(), size)
+        if not h:
+            raise ShmemError(f"failed to create shmem region {name!r} ({size} B)")
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def open(cls, name: str) -> "ShmemRegion":
+        h = _load().dtp_region_open(name.encode())
+        if not h:
+            raise ShmemError(f"failed to open shmem region {name!r}")
+        return cls(h, name, owner=False)
+
+    def close(self, unlink: bool | None = None, force: bool = False) -> None:
+        """Unmap (and unlink, if owner). Refuses to unmap while zero-copy
+        views (numpy arrays, sub-memoryviews) created from ``.buf`` are
+        still alive — unmapping under them would turn later reads into a
+        segfault. ``force=True`` unmaps anyway (caller guarantees no view
+        is touched again)."""
+        if not self._h:
+            return
+        if self._exports > 0 and not force:
+            import gc
+
+            gc.collect()  # views may be unreachable but not yet collected
+            if self._exports > 0:
+                raise BufferError(
+                    f"shmem region {self.name!r} still has {self._exports} live "
+                    f"zero-copy view(s); drop them before close() (or pass "
+                    f"force=True)"
+                )
+        self._carray = None
+        _load().dtp_region_close(
+            self._h, 1 if (self.owner if unlink is None else unlink) else 0
+        )
+        self._h = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ShmemChannel:
+    """Synchronous request-reply channel inside one shmem segment.
+
+    One side is the *server* (daemon), one the *client* (node); messages
+    alternate request/reply, sharing the payload area.
+    """
+
+    def __init__(self, handle: int, name: str, is_server: bool):
+        self._h = handle
+        self.name = name
+        self.is_server = is_server
+        self._lib = _load()
+        self.capacity = self._lib.dtp_channel_capacity(handle)
+        self._recv_buf = ctypes.create_string_buffer(self.capacity)
+
+    @classmethod
+    def create(cls, name: str, capacity: int = 1 << 20) -> "ShmemChannel":
+        h = _load().dtp_channel_create(name.encode(), capacity)
+        if not h:
+            raise ShmemError(f"failed to create shmem channel {name!r}")
+        return cls(h, name, is_server=True)
+
+    @classmethod
+    def open(cls, name: str) -> "ShmemChannel":
+        h = _load().dtp_channel_open(name.encode())
+        if not h:
+            raise ShmemError(f"failed to open shmem channel {name!r}")
+        return cls(h, name, is_server=False)
+
+    def send(self, data: bytes) -> None:
+        rc = self._lib.dtp_channel_send(
+            self._h, data, len(data), 1 if self.is_server else 0
+        )
+        if rc == -2:
+            raise Disconnected(f"channel {self.name} disconnected")
+        if rc == -3:
+            raise ShmemError(
+                f"message of {len(data)} B exceeds channel capacity {self.capacity}"
+            )
+        if rc != 0:
+            raise ShmemError(f"send failed with {rc}")
+
+    def recv(self, timeout: float | None = None) -> bytes | None:
+        """Receive one message; None on timeout; raises Disconnected."""
+        timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        n = self._lib.dtp_channel_recv(
+            self._h,
+            self._recv_buf,
+            self.capacity,
+            timeout_ms,
+            1 if self.is_server else 0,
+        )
+        if n >= 0:
+            # string_at copies exactly n bytes (``.raw[:n]`` would copy the
+            # whole channel capacity first).
+            return ctypes.string_at(self._recv_buf, n)
+        if n == -1:
+            return None
+        if n == -2:
+            raise Disconnected(f"channel {self.name} disconnected")
+        raise ShmemError(f"recv failed with {n}")
+
+    @property
+    def disconnected(self) -> bool:
+        return bool(self._lib.dtp_channel_is_disconnected(self._h))
+
+    def disconnect(self) -> None:
+        if self._h:
+            self._lib.dtp_channel_disconnect(self._h)
+
+    def close(self, unlink: bool | None = None) -> None:
+        if self._h:
+            self._lib.dtp_channel_close(
+                self._h, 1 if (self.is_server if unlink is None else unlink) else 0
+            )
+            self._h = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def unlink_region(name: str) -> None:
+    _load().dtp_region_unlink(name.encode())

@@ -1,0 +1,75 @@
+"""Shmem channel self-benchmark: cross-process request-reply round-trips.
+
+Reference parity: libraries/shared-memory-server/src/bin/bench.rs — Ping/Pong
+round-trip timing. Run: python -m dora_tpu.tools.shmem_bench [payload_bytes]
+"""
+
+from __future__ import annotations
+
+import statistics
+import subprocess
+import sys
+import time
+import uuid
+
+from dora_tpu.native import ShmemChannel
+
+CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+from dora_tpu.native import ShmemChannel
+c = ShmemChannel.open({name!r})
+try:
+    while True:
+        msg = c.recv(timeout=10)
+        if msg is None:
+            break
+        c.send(msg)
+except Exception:
+    pass
+"""
+
+
+def run(payload: int = 64, iters: int = 5000) -> dict:
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    name = f"/dtp_bench_{uuid.uuid4().hex[:8]}"
+    server = ShmemChannel.create(name, capacity=max(1 << 16, payload + 64))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", CHILD.format(repo=repo, name=name)]
+    )
+    msg = b"x" * payload
+    try:
+        # warmup
+        for _ in range(100):
+            server.send(msg)
+            server.recv(timeout=10)
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter_ns()
+            server.send(msg)
+            server.recv(timeout=10)
+            lat.append(time.perf_counter_ns() - t0)
+    finally:
+        server.disconnect()
+        proc.wait(timeout=5)
+        server.close()
+    lat.sort()
+    return {
+        "payload_bytes": payload,
+        "iters": iters,
+        "rtt_p50_us": lat[len(lat) // 2] / 1000,
+        "rtt_p99_us": lat[int(len(lat) * 0.99)] / 1000,
+        "rtt_mean_us": statistics.fmean(lat) / 1000,
+    }
+
+
+if __name__ == "__main__":
+    payload = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    stats = run(payload)
+    print(
+        f"shmem request-reply RTT ({stats['payload_bytes']} B x {stats['iters']}): "
+        f"p50={stats['rtt_p50_us']:.1f}us p99={stats['rtt_p99_us']:.1f}us "
+        f"mean={stats['rtt_mean_us']:.1f}us"
+    )

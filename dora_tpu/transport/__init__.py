@@ -1,0 +1,6 @@
+"""Transport primitives: length-prefixed socket framing and shmem RPC.
+
+Reference parity: L0 of the reference — socket_stream_utils.rs /
+tcp_utils.rs (length-prefixed framing) and shared-memory-server (the shmem
+request-reply channel, implemented natively in native/shmem.cpp here).
+"""

@@ -1,0 +1,73 @@
+"""Length-prefixed message framing over sockets (sync + asyncio).
+
+Frame = 4-byte little-endian length + payload. Used for every TCP/UDS
+channel: CLI<->coordinator, coordinator<->daemon, daemon<->daemon,
+node<->daemon in tcp/uds mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+class ConnectionClosed(ConnectionError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# sync (used by node APIs — nodes are synchronous by design)
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionClosed("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} B exceeds limit")
+    return _recv_exact(sock, length) if length else b""
+
+
+# ---------------------------------------------------------------------------
+# asyncio (used by daemon + coordinator event loops)
+# ---------------------------------------------------------------------------
+
+
+async def send_frame_async(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)))
+    writer.write(payload)
+    await writer.drain()
+
+
+async def recv_frame_async(reader: asyncio.StreamReader) -> bytes:
+    try:
+        header = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError) as e:
+        raise ConnectionClosed("peer closed connection") from e
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} B exceeds limit")
+    if not length:
+        return b""
+    try:
+        return await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError) as e:
+        raise ConnectionClosed("peer closed mid-frame") from e

@@ -138,6 +138,21 @@ class TestParse:
         assert d.node("b").deploy.machine is None
         assert d.machines() == {"gpu-1", ""}
 
+    def test_top_level_deploy_is_default(self):
+        d = parse(
+            """
+            deploy: {machine: default-m}
+            nodes:
+              - id: a
+                path: a
+              - id: b
+                path: b
+                deploy: {machine: own-m}
+            """
+        )
+        assert d.node("a").deploy.machine == "default-m"
+        assert d.node("b").deploy.machine == "own-m"
+
     def test_global_env_merged(self):
         d = parse(
             """

@@ -1,0 +1,91 @@
+import asyncio
+import socket
+import threading
+
+import pytest
+
+from dora_tpu.transport.framing import (
+    ConnectionClosed,
+    recv_frame,
+    recv_frame_async,
+    send_frame,
+    send_frame_async,
+)
+
+
+def test_sync_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    payloads = [b"", b"x", b"hello" * 1000, bytes(range(256)) * 4096]
+
+    def sender():
+        for p in payloads:
+            send_frame(a, p)
+
+    t = threading.Thread(target=sender)
+    t.start()
+    for p in payloads:
+        assert recv_frame(b) == p
+    t.join()
+    a.close()
+    with pytest.raises(ConnectionClosed):
+        recv_frame(b)
+    b.close()
+
+
+def test_async_roundtrip_over_tcp():
+    async def main():
+        received = []
+        done = asyncio.Event()
+
+        async def handler(reader, writer):
+            try:
+                while True:
+                    received.append(await recv_frame_async(reader))
+            except ConnectionClosed:
+                done.set()
+            finally:
+                writer.close()  # 3.12: Server.wait_closed() waits on transports
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        for p in (b"", b"abc", b"y" * 100_000):
+            await send_frame_async(writer, p)
+        writer.close()
+        await writer.wait_closed()
+        await asyncio.wait_for(done.wait(), 5)
+        server.close()
+        await server.wait_closed()
+        assert received == [b"", b"abc", b"y" * 100_000]
+
+    asyncio.run(main())
+
+
+def test_mixed_sync_client_async_server():
+    """Node APIs are sync, the daemon is asyncio — both must interoperate."""
+
+    async def main():
+        async def handler(reader, writer):
+            try:
+                while True:
+                    frame = await recv_frame_async(reader)
+                    await send_frame_async(writer, frame[::-1])
+            except ConnectionClosed:
+                pass
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+
+        def client():
+            s = socket.create_connection(("127.0.0.1", port))
+            send_frame(s, b"abcdef")
+            assert recv_frame(s) == b"fedcba"
+            s.close()
+
+        await asyncio.get_event_loop().run_in_executor(None, client)
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())

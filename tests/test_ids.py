@@ -19,6 +19,22 @@ def test_ids_reject_slash_and_empty():
         OperatorId("x/y")
 
 
+def test_ids_reject_bad_charset():
+    for bad in ("has space", 'quo"te', "semi;colon", "new\nline", "a b", "x\n"):
+        with pytest.raises(ValueError):
+            NodeId(bad)
+        with pytest.raises(ValueError):
+            DataId(bad)
+
+
+def test_data_id_allows_namespaced():
+    assert DataId("op/output") == "op/output"
+    with pytest.raises(ValueError):
+        DataId("op//output")
+    with pytest.raises(ValueError):
+        DataId("op/out put")
+
+
 def test_output_id_roundtrip():
     o = OutputId.parse("camera/image")
     assert o.node == NodeId("camera")

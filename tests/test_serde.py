@@ -164,3 +164,17 @@ def test_forward_compat_ignores_unknown_fields():
 def test_drop_tokens_unique_and_time_ordered():
     tokens = [new_drop_token() for _ in range(100)]
     assert len(set(tokens)) == 100
+
+
+def test_user_dicts_with_tag_like_keys_survive():
+    """User parameter dicts containing a 't' key must not be type-confused
+    with the tagged-union envelope."""
+    for params in (
+        {"t": "@ts"},
+        {"t": "Stop", "f": {}},
+        {"t": 1, "nested": {"t": "Subscribe", "f": {}}},
+    ):
+        md = Metadata(type_info=TypeInfo(encoding="raw", len=0), parameters=params)
+        out = decode(encode(md))
+        assert out.parameters == params, params
+
