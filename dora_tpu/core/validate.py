@@ -67,6 +67,8 @@ def _check_custom_source(node_id, kind: CustomNode, working_dir: Path | None) ->
         return
     if "://" in source:  # URL source, downloaded at spawn time
         return
+    if source.startswith("module:"):  # installed Python module (node hub)
+        return
     path = Path(source)
     if working_dir and not path.is_absolute():
         path = working_dir / path

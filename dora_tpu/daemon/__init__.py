@@ -1,0 +1,19 @@
+"""The per-machine data-plane daemon.
+
+Reference parity: binaries/daemon — one daemon per machine; spawns node
+processes, routes outputs to subscriber inputs over shmem/TCP/UDS, owns
+timers, tracks shared-memory lifetime via drop tokens, enforces the
+cluster-wide start barrier, classifies node failures, and stops dataflows
+with a grace-kill.
+
+Design difference: the reference is a tokio actor loop
+(binaries/daemon/src/lib.rs:274-337); here the daemon is a single asyncio
+event loop where listener coroutines mutate daemon state directly (safe:
+cooperative scheduling, no preemption between awaits). Shared-memory
+channels — whose recv blocks in native code — are pumped by executor
+threads that re-enter the loop via run_coroutine_threadsafe.
+"""
+
+from dora_tpu.daemon.core import Daemon, run_dataflow
+
+__all__ = ["Daemon", "run_dataflow"]

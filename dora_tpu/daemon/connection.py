@@ -1,0 +1,142 @@
+"""Daemon-side node-channel connections over TCP, UDS, and shared memory.
+
+A ``NodeConnection`` is one request-reply channel to one node (control,
+events, or drop). TCP/UDS connections ride one asyncio accept loop; the
+node identifies itself (and the channel kind) with its first Register
+message. Shmem channels block in native code, so each is pumped by an
+executor thread that re-enters the asyncio loop per request.
+
+Reference parity: binaries/daemon/src/node_communication/{mod,tcp}.rs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Awaitable, Callable
+
+from dora_tpu.native import Disconnected, ShmemChannel
+from dora_tpu.transport.framing import (
+    ConnectionClosed,
+    recv_frame_async,
+    send_frame_async,
+)
+
+
+class NodeConnection:
+    """One request-reply channel; recv() returns raw frames (None = closed)."""
+
+    async def recv(self) -> bytes | None:
+        raise NotImplementedError
+
+    async def send(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class StreamConnection(NodeConnection):
+    """TCP or UDS connection (asyncio streams)."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+
+    async def recv(self) -> bytes | None:
+        try:
+            return await recv_frame_async(self.reader)
+        except (ConnectionClosed, ConnectionError):
+            return None
+
+    async def send(self, payload: bytes) -> None:
+        await send_frame_async(self.writer, payload)
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class ShmemConnection(NodeConnection):
+    """Server side of one native shmem request-reply channel.
+
+    The blocking native recv runs on a dedicated pump thread (one per
+    channel) that re-enters the asyncio loop per request — executor slots
+    stay free for short-lived work.
+    """
+
+    RECV_TICK_S = 0.5
+
+    def __init__(self, channel: ShmemChannel):
+        self.channel = channel
+        self._closing = False
+        self._loop = asyncio.get_running_loop()
+        self._incoming: asyncio.Queue[bytes | None] = asyncio.Queue()
+        self._thread = threading.Thread(
+            target=self._pump, name=f"shmem-pump-{channel.name}", daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while not self._closing:
+            try:
+                data = self.channel.recv(self.RECV_TICK_S)
+            except (Disconnected, Exception):
+                break
+            if data is not None:
+                self._loop.call_soon_threadsafe(self._incoming.put_nowait, data)
+        self._loop.call_soon_threadsafe(self._incoming.put_nowait, None)
+
+    async def recv(self) -> bytes | None:
+        return await self._incoming.get()
+
+    async def send(self, payload: bytes) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, self.channel.send, payload)
+        except Disconnected:
+            raise ConnectionClosed("shmem peer disconnected") from None
+
+    def close(self) -> None:
+        """Disconnect and free the channel. The native handle is freed only
+        after the pump thread exits (freeing under a blocked recv would be a
+        use-after-free); reply sends always complete before the listener
+        calls close(), so no send can race the free either."""
+        if self._closing:
+            return
+        self._closing = True
+        try:
+            self.channel.disconnect()
+        except Exception:
+            pass
+
+        def _finish(thread=self._thread, channel=self.channel):
+            thread.join(timeout=5)
+            try:
+                channel.close()
+            except Exception:
+                pass
+
+        threading.Thread(target=_finish, daemon=True).start()
+
+
+async def serve_stream(
+    host_listener: Callable[[NodeConnection], Awaitable[None]],
+    *,
+    tcp_host: str | None = None,
+    uds_path: str | None = None,
+) -> tuple[asyncio.AbstractServer, str]:
+    """Start one accept loop; every accepted connection is handed to
+    ``host_listener`` as a StreamConnection. Returns (server, address)."""
+
+    async def on_client(reader, writer):
+        await host_listener(StreamConnection(reader, writer))
+
+    if uds_path is not None:
+        server = await asyncio.start_unix_server(on_client, path=uds_path)
+        return server, uds_path
+    server = await asyncio.start_server(on_client, host=tcp_host or "127.0.0.1", port=0)
+    addr = server.sockets[0].getsockname()
+    return server, f"{addr[0]}:{addr[1]}"

@@ -1,0 +1,996 @@
+"""The daemon: spawn dataflows, route messages, own timers and buffers.
+
+Reference parity: binaries/daemon/src/lib.rs — per-machine data plane with
+a start barrier (pending.rs), output routing with bounded per-input queues,
+shared-memory drop-token lifecycle (§2.8 of SURVEY.md), stop with grace
+kill, and failure classification (grace_duration / cascading / other).
+
+Two modes, like the reference (lib.rs:93-224):
+  * attached: `Daemon.run(coordinator_addr, machine_id)` — register with a
+    coordinator, serve Spawn/Stop/… events (dora_tpu.daemon.coordinator_conn);
+  * standalone: `run_dataflow(descriptor)` — run one dataflow to completion
+    in-process (CLI `dora daemon --run-dataflow`, tests, examples).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+from dora_tpu import PROTOCOL_VERSION
+from dora_tpu.clock import HLC
+from dora_tpu.core.config import TimerMapping, UserMapping
+from dora_tpu.core.descriptor import CustomNode, Descriptor, new_dataflow_uuid
+from dora_tpu.daemon import spawn as spawn_mod
+from dora_tpu.daemon.connection import (
+    NodeConnection,
+    ShmemConnection,
+    serve_stream,
+)
+from dora_tpu.transport.framing import ConnectionClosed
+from dora_tpu.daemon.queues import DropQueue, NodeEventQueue, QueueEntry
+from dora_tpu.ids import DataId, InputId, NodeId, OutputId
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message import node_to_daemon as n2d
+from dora_tpu.message.common import (
+    InlineData,
+    Metadata,
+    NodeError,
+    NodeErrorCause,
+    NodeExitStatus,
+    NodeResult,
+    DataflowResult,
+    SharedMemoryData,
+    TypeInfo,
+    ENCODING_RAW,
+)
+from dora_tpu.message.serde import (
+    Timestamped,
+    decode_timestamped,
+    encode_timestamped,
+)
+from dora_tpu.native import ShmemChannel, ShmemRegion
+
+logger = logging.getLogger(__name__)
+
+#: Default stop grace period before leftover nodes are killed
+#: (reference: binaries/daemon/src/lib.rs:1616).
+DEFAULT_GRACE_S = 15.0
+
+#: Control-channel shmem capacity. Payloads ≥ the zero-copy threshold travel
+#: in their own regions; the channel only carries control messages and
+#: inline payloads.
+SHMEM_CHANNEL_CAPACITY = 1 << 20
+
+
+@dataclass
+class TokenState:
+    """One shared-memory region in flight: who owns it, how many receivers
+    still reference it."""
+
+    owner: str  # node id
+    pending: int = 0
+
+
+@dataclass
+class RunningNode:
+    node_id: str
+    process: Any = None  # asyncio.subprocess.Process | None (dynamic)
+    finished: bool = False
+    dynamic: bool = False
+
+
+@dataclass
+class DataflowState:
+    id: str
+    descriptor: Descriptor
+    working_dir: Path
+    local_nodes: set[str]  # node ids this machine runs
+    #: OutputId -> receiver InputIds (local and remote alike)
+    mappings: dict[OutputId, set[InputId]] = field(default_factory=dict)
+    open_outputs: set[OutputId] = field(default_factory=set)
+    #: receiver node -> its user (non-timer) inputs that are still open
+    open_inputs: dict[str, set[str]] = field(default_factory=dict)
+    #: interval_ns -> receiver InputIds
+    timers: dict[int, set[InputId]] = field(default_factory=dict)
+    timer_tasks: list[asyncio.Task] = field(default_factory=list)
+    queues: dict[str, NodeEventQueue] = field(default_factory=dict)
+    drop_queues: dict[str, DropQueue] = field(default_factory=dict)
+    #: shmem drop tokens still referenced by receivers
+    tokens: dict[str, TokenState] = field(default_factory=dict)
+    #: per-receiver tokens delivered in a NextEvents batch but not yet acked
+    delivered_tokens: dict[str, set[str]] = field(default_factory=dict)
+    running_nodes: dict[str, RunningNode] = field(default_factory=dict)
+    node_results: dict[str, NodeResult] = field(default_factory=dict)
+    stderr_rings: dict[str, list[str]] = field(default_factory=dict)
+    #: start barrier
+    pending_nodes: set[str] = field(default_factory=set)
+    started: asyncio.Event = field(default_factory=asyncio.Event)
+    barrier_error: str | None = None
+    #: failure bookkeeping
+    failed_nodes: list[str] = field(default_factory=list)
+    grace_kills: set[str] = field(default_factory=set)
+    stop_sent: bool = False
+    done: asyncio.Future = field(default_factory=lambda: asyncio.get_event_loop().create_future())
+    #: regions this daemon mapped for routing (closed on finish)
+    mapped_regions: dict[str, ShmemRegion] = field(default_factory=dict)
+    #: shmem node-channel connections created for this dataflow
+    shmem_conns: list[Any] = field(default_factory=list)
+    #: multi-machine: machine id -> daemon listen addr (inter-daemon data)
+    machine_listen_ports: dict[str, str] = field(default_factory=dict)
+    #: node id -> set when its control-channel connection has fully drained;
+    #: exit handling waits on this so in-flight SendMessages are not lost
+    control_done: dict[str, asyncio.Event] = field(default_factory=dict)
+
+    def node_machine(self, node_id: str) -> str:
+        return self.descriptor.node(node_id).deploy.machine or ""
+
+
+class Daemon:
+    """One data-plane daemon (per machine)."""
+
+    def __init__(
+        self,
+        machine_id: str = "",
+        local_comm: str = "tcp",
+        uds_dir: str | None = None,
+    ):
+        self.machine_id = machine_id
+        self.local_comm = local_comm
+        self.uds_dir = uds_dir
+        self.clock = HLC()
+        self.dataflows: dict[str, DataflowState] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._server_addr: str | None = None
+        self._dynamic_server: asyncio.AbstractServer | None = None
+        self.dynamic_port: int | None = None
+        #: hook for attached mode: send InterDaemonEvent to another machine
+        self.inter_daemon_send: Callable[..., Any] | None = None
+        #: hook for attached mode: notify coordinator (ReadyOnMachine, logs, …)
+        self.coordinator_notify: Callable[..., Any] | None = None
+        #: optional sink for log lines (LogSubscribe streaming)
+        self.log_sink: Callable[..., Any] | None = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, dynamic_port: int | None = 0) -> None:
+        """Start the node-channel accept loop (tcp/uds) and the dynamic-node
+        bootstrap listener."""
+        if self.local_comm == "uds":
+            import tempfile
+
+            d = self.uds_dir or tempfile.mkdtemp(prefix="dora-tpu-")
+            path = str(Path(d) / f"daemon-{id(self):x}.sock")
+            self._server, self._server_addr = await serve_stream(
+                self._handle_connection, uds_path=path
+            )
+        else:
+            self._server, self._server_addr = await serve_stream(
+                self._handle_connection
+            )
+        if dynamic_port is not None:
+            self._dynamic_server = await asyncio.start_server(
+                self._handle_dynamic_client, host="127.0.0.1", port=dynamic_port
+            )
+            self.dynamic_port = self._dynamic_server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        for server in (self._server, self._dynamic_server):
+            if server is not None:
+                server.close()
+                try:
+                    await server.wait_closed()
+                except Exception:
+                    pass
+        for df in list(self.dataflows.values()):
+            for t in df.timer_tasks:
+                t.cancel()
+            for region in df.mapped_regions.values():
+                try:
+                    region.close(unlink=False, force=True)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------
+    # dataflow spawn
+    # ------------------------------------------------------------------
+
+    async def spawn_dataflow(
+        self,
+        descriptor: Descriptor,
+        dataflow_id: str | None = None,
+        working_dir: str | Path | None = None,
+        local_nodes: set[str] | None = None,
+        machine_listen_ports: dict[str, str] | None = None,
+    ) -> DataflowState:
+        """Build routing tables and spawn this machine's (non-dynamic) nodes."""
+        dataflow_id = dataflow_id or new_dataflow_uuid()
+        working_dir = Path(working_dir or Path.cwd()).resolve()
+        if local_nodes is None:
+            local_nodes = {
+                str(n.id)
+                for n in descriptor.nodes
+                if (n.deploy.machine or "") == self.machine_id
+            }
+
+        df = DataflowState(
+            id=dataflow_id,
+            descriptor=descriptor,
+            working_dir=working_dir,
+            local_nodes=local_nodes,
+            machine_listen_ports=dict(machine_listen_ports or {}),
+        )
+        self.dataflows[dataflow_id] = df
+
+        # Routing tables (reference: daemon/src/lib.rs:628-660).
+        for node in descriptor.nodes:
+            for output in node.outputs:
+                df.open_outputs.add(OutputId(node.id, output))
+        for node in descriptor.nodes:
+            nid = str(node.id)
+            for input_id, inp in node.inputs.items():
+                target = InputId(node.id, input_id)
+                if isinstance(inp.mapping, TimerMapping):
+                    df.timers.setdefault(inp.mapping.interval_ns, set()).add(target)
+                else:
+                    mapping: UserMapping = inp.mapping
+                    df.mappings.setdefault(mapping.output_id, set()).add(target)
+                    df.open_inputs.setdefault(nid, set()).add(str(input_id))
+
+        # Per-local-node queues + barrier membership.
+        for node in descriptor.nodes:
+            nid = str(node.id)
+            if nid not in local_nodes:
+                continue
+            queue_sizes = {
+                str(iid): inp.queue_size for iid, inp in node.inputs.items()
+            }
+            df.queues[nid] = NodeEventQueue(
+                node_id=nid,
+                queue_sizes=queue_sizes,
+                on_token_unref=lambda token, df=df: self._unref_token(df, token),
+            )
+            df.drop_queues[nid] = DropQueue()
+            df.control_done[nid] = asyncio.Event()
+            dynamic = isinstance(node.kind, CustomNode) and node.kind.is_dynamic
+            df.running_nodes[nid] = RunningNode(node_id=nid, dynamic=dynamic)
+            if not dynamic:
+                df.pending_nodes.add(nid)
+
+        # Spawn processes.
+        for node in descriptor.nodes:
+            nid = str(node.id)
+            if nid not in local_nodes or df.running_nodes[nid].dynamic:
+                continue
+            node_config = self._make_node_config(df, nid)
+            try:
+                process = await spawn_mod.spawn_node(self, df, node, node_config)
+            except RuntimeError as e:
+                self.handle_node_exit(df, node.id, None, error=str(e))
+                continue
+            df.running_nodes[nid].process = process
+
+        if not df.pending_nodes:
+            self._release_barrier(df)
+        return df
+
+    def _make_node_config(self, df: DataflowState, node_id: str) -> d2n.NodeConfig:
+        node = df.descriptor.node(node_id)
+        run_config = d2n.RunConfig(
+            inputs={str(i): inp.queue_size for i, inp in node.inputs.items()},
+            outputs=[str(o) for o in node.outputs],
+        )
+        if self.local_comm == "shmem":
+            prefix = f"dtp-{df.id[:8]}-{node_id}"
+            comm: Any = d2n.ShmemCommunication(
+                control_region_id=f"{prefix}-ctl",
+                events_region_id=f"{prefix}-evt",
+                drop_region_id=f"{prefix}-drop",
+            )
+            for name in (comm.control_region_id, comm.events_region_id,
+                         comm.drop_region_id):
+                channel = ShmemChannel.create(name, SHMEM_CHANNEL_CAPACITY)
+                conn = ShmemConnection(channel)
+                df.shmem_conns.append(conn)
+                asyncio.create_task(self._handle_connection(conn))
+        elif self.local_comm == "uds":
+            comm = d2n.UnixDomainCommunication(socket_file=self._server_addr)
+        else:
+            comm = d2n.TcpCommunication(socket_addr=self._server_addr)
+        return d2n.NodeConfig(
+            dataflow_id=df.id,
+            node_id=node_id,
+            run_config=run_config,
+            daemon_communication=comm,
+            dataflow_descriptor=dict(df.descriptor.raw),
+            dynamic=df.running_nodes.get(node_id, RunningNode(node_id)).dynamic,
+        )
+
+    # ------------------------------------------------------------------
+    # start barrier (reference: binaries/daemon/src/pending.rs)
+    # ------------------------------------------------------------------
+
+    def _node_subscribed(self, df: DataflowState, node_id: str) -> None:
+        if node_id in df.pending_nodes:
+            df.pending_nodes.discard(node_id)
+            if not df.pending_nodes:
+                if self.coordinator_notify is not None and len(
+                    df.descriptor.machines()
+                ) > 1:
+                    # Multi-machine: coordinator aggregates ReadyOnMachine and
+                    # broadcasts AllNodesReady (coordinator/src/lib.rs:221-267).
+                    self.coordinator_notify("ready", df, [])
+                else:
+                    self._release_barrier(df)
+
+    def _release_barrier(self, df: DataflowState, error: str | None = None) -> None:
+        df.barrier_error = error
+        df.started.set()
+        if error is None:
+            self._start_timers(df)
+
+    def poison_barrier(self, df: DataflowState, failed_node: str) -> None:
+        """A node exited before subscribing: fail the whole start barrier
+        (reference: pending.rs:160-190)."""
+        if not df.started.is_set():
+            self._release_barrier(
+                df, error=f"node {failed_node!r} exited before subscribing"
+            )
+
+    # ------------------------------------------------------------------
+    # timers (reference: daemon/src/lib.rs:1539-1592)
+    # ------------------------------------------------------------------
+
+    def _start_timers(self, df: DataflowState) -> None:
+        for interval_ns, targets in df.timers.items():
+            df.timer_tasks.append(
+                asyncio.create_task(self._timer_loop(df, interval_ns, targets))
+            )
+
+    async def _timer_loop(self, df, interval_ns: int, targets: set[InputId]):
+        period = interval_ns / 1e9
+        timer_id = str(TimerMapping(interval_ns=interval_ns).data_id)
+        next_tick = time.monotonic() + period
+        while True:
+            delay = next_tick - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            next_tick += period
+            metadata = Metadata(
+                type_info=TypeInfo(encoding=ENCODING_RAW, len=0),
+                parameters={"timer": timer_id},
+            )
+            for target in targets:
+                queue = df.queues.get(str(target.node))
+                if queue is None:
+                    continue
+                event = d2n.Input(id=str(target.input), metadata=metadata, data=None)
+                queue.push(
+                    Timestamped(inner=event, timestamp=self.clock.new_timestamp()),
+                    input_id=str(target.input),
+                )
+
+    # ------------------------------------------------------------------
+    # routing (reference: daemon/src/lib.rs:955-1003, 1314-1390)
+    # ------------------------------------------------------------------
+
+    def send_out(
+        self,
+        df: DataflowState,
+        sender: str,
+        output_id: str,
+        metadata: Metadata,
+        data: Any,
+    ) -> None:
+        """Route one output to all local receiver queues and remote machines."""
+        oid = OutputId(NodeId(sender), DataId(output_id))
+        token = data.drop_token if isinstance(data, SharedMemoryData) else None
+        if oid not in df.open_outputs:
+            if token:
+                self._notify_owner(df, sender, token)
+            return
+        receivers = df.mappings.get(oid, ())
+        if token is not None:
+            df.tokens[token] = TokenState(owner=sender)
+
+        remote_machines: set[str] = set()
+        for target in receivers:
+            rnode = str(target.node)
+            if rnode in df.local_nodes:
+                queue = df.queues.get(rnode)
+                open_inputs = df.open_inputs.get(rnode, set())
+                if queue is None or str(target.input) not in open_inputs:
+                    continue
+                if token is not None:
+                    df.tokens[token].pending += 1
+                event = d2n.Input(
+                    id=str(target.input), metadata=metadata, data=data
+                )
+                queue.push(
+                    Timestamped(inner=event, timestamp=self.clock.new_timestamp()),
+                    input_id=str(target.input),
+                    drop_token=token,
+                )
+            else:
+                remote_machines.add(df.node_machine(rnode))
+
+        if remote_machines and self.inter_daemon_send is not None:
+            # Shared memory never crosses machines: copy payload to bytes.
+            payload = self._payload_bytes(df, data)
+            for machine in remote_machines:
+                self.inter_daemon_send(df, machine, str(oid), metadata, payload)
+
+        if token is not None and df.tokens[token].pending == 0:
+            del df.tokens[token]
+            self._notify_owner(df, sender, token)
+
+    def deliver_remote_output(
+        self, df: DataflowState, output_id: str, metadata: Metadata, payload: bytes | None
+    ) -> None:
+        """An output forwarded from another machine's daemon."""
+        oid = OutputId.parse(output_id)
+        data = InlineData(data=payload) if payload is not None else None
+        for target in df.mappings.get(oid, ()):  # local receivers only
+            rnode = str(target.node)
+            if rnode not in df.local_nodes:
+                continue
+            queue = df.queues.get(rnode)
+            open_inputs = df.open_inputs.get(rnode, set())
+            if queue is None or str(target.input) not in open_inputs:
+                continue
+            event = d2n.Input(id=str(target.input), metadata=metadata, data=data)
+            queue.push(
+                Timestamped(inner=event, timestamp=self.clock.new_timestamp()),
+                input_id=str(target.input),
+            )
+
+    def _payload_bytes(self, df: DataflowState, data: Any) -> bytes | None:
+        if data is None:
+            return None
+        if isinstance(data, InlineData):
+            return bytes(data.data)
+        region = self._map_region(df, data.shmem_id)
+        return bytes(region.buf[: data.len])
+
+    def _map_region(self, df: DataflowState, shmem_id: str) -> ShmemRegion:
+        region = df.mapped_regions.get(shmem_id)
+        if region is None:
+            region = ShmemRegion.open(shmem_id)
+            df.mapped_regions[shmem_id] = region
+        return region
+
+    def publish_stdout_line(
+        self, df: DataflowState, node_id: NodeId, output: str, line: str
+    ) -> None:
+        """Re-publish a stdout line as a dataflow output (``send_stdout_as``,
+        reference: daemon/src/lib.rs:1174-1220). Payload is an Arrow string
+        array in IPC format so receivers decode it like any other input."""
+        from dora_tpu.node.arrow import ipc_bytes_str
+
+        payload = ipc_bytes_str(line)
+        metadata = Metadata(
+            type_info=TypeInfo(encoding="arrow-ipc", len=len(payload)),
+            parameters={},
+        )
+        self.send_out(df, str(node_id), output, metadata, InlineData(data=payload))
+
+    # ------------------------------------------------------------------
+    # drop tokens (reference: SURVEY.md §2.8)
+    # ------------------------------------------------------------------
+
+    def _unref_token(self, df: DataflowState, token: str) -> None:
+        state = df.tokens.get(token)
+        if state is None:
+            return
+        state.pending -= 1
+        if state.pending <= 0:
+            del df.tokens[token]
+            self._notify_owner(df, state.owner, token)
+
+    def _notify_owner(self, df: DataflowState, owner: str, token: str) -> None:
+        drop_queue = df.drop_queues.get(owner)
+        if drop_queue is not None:
+            drop_queue.push(token)
+
+    def ack_tokens(self, df: DataflowState, node_id: str, tokens: list[str]) -> None:
+        delivered = df.delivered_tokens.get(node_id)
+        for token in tokens:
+            if delivered is not None:
+                delivered.discard(token)
+            self._unref_token(df, token)
+
+    # ------------------------------------------------------------------
+    # output closing / node exit
+    # ------------------------------------------------------------------
+
+    def close_outputs(self, df: DataflowState, node_id: str, outputs: list[str]) -> None:
+        """Close outputs; propagate InputClosed/AllInputsClosed downstream
+        (and InputsClosed to remote machines)."""
+        remote_closed: dict[str, list[str]] = {}
+        for output in outputs:
+            oid = OutputId(NodeId(node_id), DataId(output))
+            if oid not in df.open_outputs:
+                continue
+            df.open_outputs.discard(oid)
+            for target in df.mappings.get(oid, ()):
+                rnode = str(target.node)
+                if rnode not in df.local_nodes:
+                    remote_closed.setdefault(
+                        df.node_machine(rnode), []
+                    ).append(str(target))
+                    continue
+                self._close_local_input(df, rnode, str(target.input))
+        if remote_closed and self.inter_daemon_send is not None:
+            for machine, inputs in remote_closed.items():
+                self.inter_daemon_send(df, machine, None, None, None, closed=inputs)
+
+    def _close_local_input(self, df: DataflowState, rnode: str, input_id: str) -> None:
+        open_inputs = df.open_inputs.get(rnode)
+        if open_inputs is None or input_id not in open_inputs:
+            return
+        open_inputs.discard(input_id)
+        queue = df.queues.get(rnode)
+        if queue is None:
+            return
+        queue.push(
+            Timestamped(
+                inner=d2n.InputClosed(id=input_id),
+                timestamp=self.clock.new_timestamp(),
+            )
+        )
+        if not open_inputs and not self._has_timer_inputs(df, rnode):
+            queue.push(
+                Timestamped(
+                    inner=d2n.AllInputsClosed(),
+                    timestamp=self.clock.new_timestamp(),
+                )
+            )
+            queue.close()
+
+    def close_remote_inputs(self, df: DataflowState, inputs: list[str]) -> None:
+        """InputsClosed forwarded from another machine."""
+        for s in inputs:
+            node, _, input_id = s.partition("/")
+            self._close_local_input(df, node, input_id)
+
+    def _has_timer_inputs(self, df: DataflowState, node_id: str) -> bool:
+        return any(
+            str(t.node) == node_id for targets in df.timers.values() for t in targets
+        )
+
+    def handle_node_exit(
+        self,
+        df: DataflowState,
+        node_id: NodeId | str,
+        returncode: int | None,
+        error: str | None = None,
+    ) -> None:
+        nid = str(node_id)
+        running = df.running_nodes.get(nid)
+        if running is None or running.finished:
+            return
+        running.finished = True
+
+        if error is not None:
+            status = NodeExitStatus(success=False, error=error)
+        elif returncode == 0:
+            status = NodeExitStatus(success=True, code=0)
+        elif returncode is not None and returncode < 0:
+            status = NodeExitStatus(success=False, signal=-returncode)
+        else:
+            status = NodeExitStatus(success=False, code=returncode)
+
+        if status.success:
+            result = NodeResult(error=None)
+        else:
+            if nid in df.grace_kills:
+                cause = NodeErrorCause(kind="grace_duration")
+            elif df.failed_nodes:
+                cause = NodeErrorCause(
+                    kind="cascading", caused_by_node=df.failed_nodes[0]
+                )
+            elif df.barrier_error is not None and nid not in df.barrier_error:
+                cause = NodeErrorCause(
+                    kind="cascading",
+                    caused_by_node=df.barrier_error.split("'")[1]
+                    if "'" in df.barrier_error
+                    else None,
+                )
+            else:
+                stderr = "\n".join(df.stderr_rings.get(nid, [])) or None
+                cause = NodeErrorCause(kind="other", stderr=stderr)
+            result = NodeResult(error=NodeError(exit_status=status, cause=cause))
+            df.failed_nodes.append(nid)
+        df.node_results[nid] = result
+
+        # Barrier poison: node died before subscribing.
+        if nid in df.pending_nodes:
+            df.pending_nodes.discard(nid)
+            if not status.success:
+                self.poison_barrier(df, nid)
+            elif not df.pending_nodes:
+                self._release_barrier(df)
+
+        # Release buffers the dead node still referenced.
+        queue = df.queues.get(nid)
+        if queue is not None:
+            queue.release_all_tokens()
+            queue.close()
+        for token in df.delivered_tokens.pop(nid, set()):
+            self._unref_token(df, token)
+        drop_queue = df.drop_queues.get(nid)
+        if drop_queue is not None:
+            drop_queue.close()
+
+        # Output closing + finish-check are deferred until the node's control
+        # connection has drained: SendMessages still in the socket buffer at
+        # exit time must route before the outputs close.
+        asyncio.create_task(self._finalize_node_exit(df, nid))
+
+    async def _finalize_node_exit(self, df: DataflowState, nid: str) -> None:
+        done = df.control_done.get(nid)
+        if done is not None and not done.is_set():
+            try:
+                await asyncio.wait_for(done.wait(), timeout=2)
+            except asyncio.TimeoutError:
+                pass
+        node = df.descriptor.node(nid)
+        self.close_outputs(df, nid, [str(o) for o in node.outputs])
+        self._check_dataflow_finished(df)
+
+    def _check_dataflow_finished(self, df: DataflowState) -> None:
+        pending = [
+            r
+            for r in df.running_nodes.values()
+            if not r.finished and not r.dynamic
+        ]
+        if pending:
+            return
+        for t in df.timer_tasks:
+            t.cancel()
+        df.timer_tasks.clear()
+        for queue in df.queues.values():
+            queue.release_all_tokens()
+            queue.close()
+        for dq in df.drop_queues.values():
+            dq.close()
+        for region in df.mapped_regions.values():
+            try:
+                region.close(unlink=False, force=True)
+            except Exception:
+                pass
+        df.mapped_regions.clear()
+        for conn in df.shmem_conns:
+            conn.close()
+        df.shmem_conns.clear()
+        result = DataflowResult(
+            uuid=df.id,
+            node_results={
+                nid: df.node_results.get(nid, NodeResult(error=None))
+                for nid, r in df.running_nodes.items()
+                if not r.dynamic or nid in df.node_results
+            },
+        )
+        if not df.done.done():
+            df.done.set_result(result)
+        if self.coordinator_notify is not None:
+            self.coordinator_notify("finished", df, result)
+
+    # ------------------------------------------------------------------
+    # stop (reference: daemon/src/lib.rs:1594-1636)
+    # ------------------------------------------------------------------
+
+    def stop_dataflow(self, df: DataflowState, grace_s: float | None = None) -> None:
+        if df.stop_sent:
+            return
+        df.stop_sent = True
+        if not df.started.is_set():
+            self._release_barrier(df, error="dataflow stopped before start")
+        for nid, queue in df.queues.items():
+            running = df.running_nodes.get(nid)
+            if running is not None and running.finished:
+                continue
+            queue.push(
+                Timestamped(inner=d2n.Stop(), timestamp=self.clock.new_timestamp())
+            )
+            queue.close()
+        asyncio.create_task(self._grace_kill(df, grace_s or DEFAULT_GRACE_S))
+
+    async def _grace_kill(self, df: DataflowState, grace_s: float) -> None:
+        await asyncio.sleep(grace_s)
+        for nid, running in df.running_nodes.items():
+            if running.finished or running.process is None:
+                continue
+            df.grace_kills.add(nid)
+            try:
+                running.process.kill()
+            except ProcessLookupError:
+                pass
+
+    def reload_node(self, df: DataflowState, node_id: str, operator_id: str | None) -> None:
+        queue = df.queues.get(node_id)
+        if queue is not None:
+            queue.push(
+                Timestamped(
+                    inner=d2n.Reload(operator_id=operator_id),
+                    timestamp=self.clock.new_timestamp(),
+                )
+            )
+
+    # ------------------------------------------------------------------
+    # logging
+    # ------------------------------------------------------------------
+
+    def on_node_log(self, df: DataflowState, node_id: str, level: str, text: str) -> None:
+        if self.log_sink is not None:
+            from dora_tpu.message.common import LogMessage
+
+            self.log_sink(
+                LogMessage(
+                    dataflow_id=df.id,
+                    level=level,
+                    message=text,
+                    node_id=node_id,
+                    machine_id=self.machine_id,
+                )
+            )
+
+    # ------------------------------------------------------------------
+    # node-channel listeners
+    # ------------------------------------------------------------------
+
+    async def _handle_connection(self, conn: NodeConnection) -> None:
+        try:
+            frame = await conn.recv()
+            if frame is None:
+                return
+            ts = decode_timestamped(frame, self.clock)
+            register = ts.inner
+            if not isinstance(register, n2d.Register):
+                await self._reply(conn, d2n.ReplyResult(error="expected Register"))
+                return
+            error = self._check_register(register)
+            await self._reply(conn, d2n.ReplyResult(error=error))
+            if error is not None:
+                return
+            df = self.dataflows[register.dataflow_id]
+            node_id = register.node_id
+            if register.channel == n2d.CHANNEL_CONTROL:
+                await self._control_loop(df, node_id, conn)
+            elif register.channel == n2d.CHANNEL_EVENTS:
+                await self._events_loop(df, node_id, conn)
+            elif register.channel == n2d.CHANNEL_DROP:
+                await self._drop_loop(df, node_id, conn)
+        except (ConnectionError, ConnectionClosed):
+            pass  # node went away mid-reply; its exit watcher reports it
+        except Exception:
+            logger.exception("node connection failed")
+        finally:
+            conn.close()
+
+    def _check_register(self, register: n2d.Register) -> str | None:
+        ours = PROTOCOL_VERSION.split(".")[:2]
+        theirs = register.protocol_version.split(".")[:2]
+        if ours != theirs:
+            return (
+                f"incompatible protocol version {register.protocol_version} "
+                f"(daemon speaks {PROTOCOL_VERSION})"
+            )
+        df = self.dataflows.get(register.dataflow_id)
+        if df is None:
+            return f"unknown dataflow {register.dataflow_id!r}"
+        if register.node_id not in df.queues:
+            return f"unknown node {register.node_id!r} on this machine"
+        return None
+
+    async def _reply(self, conn: NodeConnection, msg: Any) -> None:
+        await conn.send(encode_timestamped(msg, self.clock))
+
+    async def _control_loop(self, df: DataflowState, node_id: str, conn) -> None:
+        try:
+            await self._control_loop_inner(df, node_id, conn)
+        finally:
+            done = df.control_done.get(node_id)
+            if done is not None:
+                done.set()
+
+    async def _control_loop_inner(self, df: DataflowState, node_id: str, conn) -> None:
+        while True:
+            frame = await conn.recv()
+            if frame is None:
+                return
+            msg = decode_timestamped(frame, self.clock).inner
+            if isinstance(msg, n2d.SendMessage):
+                self.send_out(df, node_id, msg.output_id, msg.metadata, msg.data)
+            elif isinstance(msg, n2d.ReportDropTokens):
+                self.ack_tokens(df, node_id, msg.drop_tokens)
+            elif isinstance(msg, n2d.CloseOutputs):
+                self.close_outputs(df, node_id, msg.outputs)
+                await self._reply(conn, d2n.ReplyResult())
+            elif isinstance(msg, n2d.OutputsDone):
+                node = df.descriptor.node(node_id)
+                # The send_stdout_as output is produced by the daemon-side
+                # stdout pump, not the node's control channel — it closes at
+                # exit-finalize time, after the pump drained (otherwise the
+                # node's own close() races its final stdout lines away).
+                stdout_output = node.send_stdout_as
+                self.close_outputs(
+                    df,
+                    node_id,
+                    [str(o) for o in node.outputs if str(o) != stdout_output],
+                )
+                await self._reply(conn, d2n.ReplyResult())
+            else:
+                await self._reply(
+                    conn,
+                    d2n.ReplyResult(error=f"unexpected control request {type(msg).__name__}"),
+                )
+
+    async def _events_loop(self, df: DataflowState, node_id: str, conn) -> None:
+        frame = await conn.recv()
+        if frame is None:
+            return
+        msg = decode_timestamped(frame, self.clock).inner
+        if not isinstance(msg, n2d.Subscribe):
+            await self._reply(conn, d2n.ReplyResult(error="expected Subscribe"))
+            return
+        # Start barrier: withhold the reply until all nodes subscribed.
+        self._node_subscribed(df, node_id)
+        await df.started.wait()
+        await self._reply(conn, d2n.ReplyResult(error=df.barrier_error))
+        if df.barrier_error is not None:
+            return
+
+        queue = df.queues[node_id]
+        delivered = df.delivered_tokens.setdefault(node_id, set())
+        while True:
+            frame = await conn.recv()
+            if frame is None:
+                return
+            msg = decode_timestamped(frame, self.clock).inner
+            if isinstance(msg, n2d.NextEvent):
+                self.ack_tokens(df, node_id, msg.drop_tokens)
+                batch = await queue.next_batch()
+                for event in batch:
+                    token = _event_token(event)
+                    if token is not None:
+                        delivered.add(token)
+                await self._reply(conn, d2n.NextEvents(events=batch))
+            elif isinstance(msg, n2d.EventStreamDropped):
+                queue.release_all_tokens()
+                queue.close()
+                await self._reply(conn, d2n.ReplyResult())
+            else:
+                await self._reply(
+                    conn,
+                    d2n.ReplyResult(error=f"unexpected event request {type(msg).__name__}"),
+                )
+
+    async def _drop_loop(self, df: DataflowState, node_id: str, conn) -> None:
+        frame = await conn.recv()
+        if frame is None:
+            return
+        msg = decode_timestamped(frame, self.clock).inner
+        if not isinstance(msg, n2d.SubscribeDrop):
+            await self._reply(conn, d2n.ReplyResult(error="expected SubscribeDrop"))
+            return
+        await self._reply(conn, d2n.ReplyResult())
+        drop_queue = df.drop_queues[node_id]
+        while True:
+            frame = await conn.recv()
+            if frame is None:
+                return
+            msg = decode_timestamped(frame, self.clock).inner
+            if isinstance(msg, n2d.NextDropEvents):
+                tokens = await drop_queue.next_batch()
+                await self._reply(conn, d2n.DropEvents(drop_tokens=tokens))
+            elif isinstance(msg, n2d.ReportDropTokens):
+                self.ack_tokens(df, node_id, msg.drop_tokens)
+            else:
+                await self._reply(
+                    conn,
+                    d2n.ReplyResult(error=f"unexpected drop request {type(msg).__name__}"),
+                )
+
+    # ------------------------------------------------------------------
+    # dynamic-node bootstrap (reference: daemon/src/local_listener.rs)
+    # ------------------------------------------------------------------
+
+    async def _handle_dynamic_client(self, reader, writer) -> None:
+        from dora_tpu.transport.framing import recv_frame_async, send_frame_async
+
+        try:
+            frame = await recv_frame_async(reader)
+            msg = decode_timestamped(frame, self.clock).inner
+            if not isinstance(msg, n2d.NodeConfigRequest):
+                reply = d2n.NodeConfigReply(error="expected NodeConfigRequest")
+            else:
+                reply = self._dynamic_node_config(msg.node_id)
+            await send_frame_async(
+                writer, encode_timestamped(reply, self.clock)
+            )
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _dynamic_node_config(self, node_id: str) -> d2n.NodeConfigReply:
+        matches = []
+        for df in self.dataflows.values():
+            running = df.running_nodes.get(node_id)
+            if running is not None and running.dynamic and not running.finished:
+                matches.append(df)
+        if not matches:
+            return d2n.NodeConfigReply(
+                error=f"no running dataflow has a dynamic node {node_id!r}"
+            )
+        if len(matches) > 1:
+            return d2n.NodeConfigReply(
+                error=f"multiple running dataflows have a dynamic node {node_id!r}; "
+                f"cannot disambiguate"
+            )
+        df = matches[0]
+        return d2n.NodeConfigReply(node_config=self._make_node_config(df, node_id))
+
+
+def _event_token(event: Timestamped) -> str | None:
+    inner = event.inner
+    if isinstance(inner, d2n.Input) and isinstance(inner.data, SharedMemoryData):
+        return inner.data.drop_token
+    return None
+
+
+# ---------------------------------------------------------------------------
+# standalone mode (reference: daemon/src/lib.rs:157-224)
+# ---------------------------------------------------------------------------
+
+
+async def run_dataflow_async(
+    dataflow: str | Path | Descriptor,
+    working_dir: str | Path | None = None,
+    local_comm: str = "tcp",
+    timeout_s: float | None = None,
+) -> DataflowResult:
+    """Run one dataflow to completion with an in-process daemon."""
+    if isinstance(dataflow, Descriptor):
+        descriptor = dataflow
+        working_dir = Path(working_dir or Path.cwd())
+    else:
+        path = Path(dataflow)
+        descriptor = Descriptor.read(path)
+        working_dir = Path(working_dir or path.parent)
+    descriptor.check(working_dir)
+
+    daemon = Daemon(local_comm=local_comm)
+    await daemon.start()
+    try:
+        df = await daemon.spawn_dataflow(
+            descriptor,
+            working_dir=working_dir,
+            local_nodes={str(n.id) for n in descriptor.nodes},
+        )
+        if timeout_s is not None:
+            return await asyncio.wait_for(asyncio.shield(df.done), timeout_s)
+        return await df.done
+    finally:
+        await daemon.close()
+
+
+def run_dataflow(
+    dataflow: str | Path | Descriptor,
+    working_dir: str | Path | None = None,
+    local_comm: str = "tcp",
+    timeout_s: float | None = None,
+) -> DataflowResult:
+    return asyncio.run(
+        run_dataflow_async(dataflow, working_dir, local_comm, timeout_s)
+    )

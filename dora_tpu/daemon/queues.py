@@ -1,0 +1,147 @@
+"""Daemon-side per-node event queues with bounded per-input backlog.
+
+Reference parity: binaries/daemon/src/node_communication/mod.rs:192-359 —
+each (node, input) has a bounded queue (YAML ``queue_size``, default 10);
+overflow drops the *oldest* queued event of that input and immediately
+releases its shared-memory drop token so the sender can reuse the region.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from dora_tpu.core.config import DEFAULT_QUEUE_SIZE
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message.common import SharedMemoryData
+from dora_tpu.message.serde import Timestamped
+
+
+@dataclass
+class QueueEntry:
+    event: Timestamped  # Timestamped[NodeEvent]
+    input_id: str | None = None  # set for Input events (drop-oldest scope)
+    drop_token: str | None = None
+
+
+@dataclass
+class NodeEventQueue:
+    """Events awaiting one node's next blocking NextEvent poll."""
+
+    node_id: str
+    queue_sizes: dict[str, int]  # input id -> bound
+    on_token_unref: Callable[[str], None]  # release a dropped event's token
+    entries: deque[QueueEntry] = field(default_factory=deque)
+    input_counts: dict[str, int] = field(default_factory=dict)
+    waiter: asyncio.Future | None = None
+    closed: bool = False  # no more events will ever arrive
+
+    def push(self, event: Timestamped, input_id: str | None = None,
+             drop_token: str | None = None) -> None:
+        if self.closed:
+            if drop_token is not None:
+                self.on_token_unref(drop_token)
+            return
+        if input_id is not None:
+            bound = self.queue_sizes.get(input_id, DEFAULT_QUEUE_SIZE)
+            count = self.input_counts.get(input_id, 0)
+            if count >= bound:
+                self._drop_oldest(input_id)
+            self.input_counts[input_id] = self.input_counts.get(input_id, 0) + 1
+        self.entries.append(QueueEntry(event, input_id, drop_token))
+        self._wake()
+
+    def _drop_oldest(self, input_id: str) -> None:
+        for i, entry in enumerate(self.entries):
+            if entry.input_id == input_id:
+                del self.entries[i]
+                self.input_counts[input_id] -= 1
+                if entry.drop_token is not None:
+                    self.on_token_unref(entry.drop_token)
+                return
+
+    def close(self) -> None:
+        """Mark the stream closed: pending entries still drain, then polls
+        return empty (= end of stream)."""
+        self.closed = True
+        self._wake()
+
+    def drain_now(self) -> list[Timestamped]:
+        out = []
+        while self.entries:
+            entry = self.entries.popleft()
+            if entry.input_id is not None:
+                self.input_counts[entry.input_id] -= 1
+            out.append(entry.event)
+        return out
+
+    def release_all_tokens(self) -> None:
+        """Stream abandoned (node died): ack every queued shmem token."""
+        for entry in self.entries:
+            if entry.drop_token is not None:
+                self.on_token_unref(entry.drop_token)
+        self.entries.clear()
+        self.input_counts.clear()
+
+    async def next_batch(self) -> list[Timestamped]:
+        """Block until events are available (or the stream closes); drain the
+        whole backlog in one batch. Empty list = stream closed."""
+        while not self.entries:
+            if self.closed:
+                return []
+            if self.waiter is None or self.waiter.done():
+                self.waiter = asyncio.get_running_loop().create_future()
+            try:
+                await self.waiter
+            except asyncio.CancelledError:
+                raise
+        return self.drain_now()
+
+    def _wake(self) -> None:
+        if self.waiter is not None and not self.waiter.done():
+            self.waiter.set_result(None)
+
+
+@dataclass
+class DropQueue:
+    """Released drop tokens awaiting the owning node's NextDropEvents poll."""
+
+    tokens: list[str] = field(default_factory=list)
+    waiter: asyncio.Future | None = None
+    closed: bool = False
+
+    def push(self, token: str) -> None:
+        if self.closed:
+            return
+        self.tokens.append(token)
+        self._wake()
+
+    def close(self) -> None:
+        self.closed = True
+        self._wake()
+
+    async def next_batch(self) -> list[str]:
+        while not self.tokens:
+            if self.closed:
+                return []
+            if self.waiter is None or self.waiter.done():
+                self.waiter = asyncio.get_running_loop().create_future()
+            await self.waiter
+        out, self.tokens = self.tokens, []
+        return out
+
+    def _wake(self) -> None:
+        if self.waiter is not None and not self.waiter.done():
+            self.waiter.set_result(None)
+
+
+def event_input_id(event: Any) -> str | None:
+    return event.id if isinstance(event, d2n.Input) else None
+
+
+def event_drop_token(event: Any) -> str | None:
+    if isinstance(event, d2n.Input) and isinstance(event.data, SharedMemoryData):
+        return event.data.drop_token
+    return None

@@ -1,0 +1,178 @@
+"""Node process spawning: source resolution, config injection, log pumps.
+
+Reference parity: binaries/daemon/src/spawn.rs:42-462 — resolve the node
+source (dynamic / shell / path / .py), inject ``DORA_NODE_CONFIG``, pipe
+stdout/stderr to a per-node log file, keep a small stderr ring buffer for
+error reports, re-publish stdout as a dataflow output when
+``send_stdout_as`` is set, and watch for process exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import os
+import shlex
+import sys
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+from dora_tpu.core.descriptor import (
+    DYNAMIC_SOURCE,
+    SHELL_SOURCE,
+    CustomNode,
+    ResolvedNode,
+    RuntimeNode,
+)
+from dora_tpu.message.daemon_to_node import NodeConfig
+from dora_tpu.message.serde import decode, encode
+
+if TYPE_CHECKING:
+    from dora_tpu.daemon.core import Daemon, DataflowState
+
+#: Last-N stderr lines kept for failure reports
+#: (reference: binaries/daemon/src/lib.rs:69).
+STDERR_RING_LINES = 10
+
+NODE_CONFIG_ENV = "DORA_NODE_CONFIG"
+
+
+def encode_node_config(cfg: NodeConfig) -> str:
+    """NodeConfig -> env-var-safe string (base64 of the wire encoding)."""
+    return base64.b64encode(encode(cfg)).decode("ascii")
+
+
+def decode_node_config(value: str) -> NodeConfig:
+    cfg = decode(base64.b64decode(value.encode("ascii")))
+    if not isinstance(cfg, NodeConfig):
+        raise ValueError("DORA_NODE_CONFIG does not contain a NodeConfig")
+    return cfg
+
+
+def log_file_path(working_dir: Path, dataflow_id: str, node_id: str) -> Path:
+    """out/<dataflow-id>/log_<node>.txt (reference: daemon/src/log.rs)."""
+    return working_dir / "out" / dataflow_id / f"log_{node_id}.txt"
+
+
+def resolve_command(node: ResolvedNode, working_dir: Path) -> list[str] | str:
+    """Resolve a node's source to an argv list (or a shell string).
+
+    - ``path: shell`` runs ``args`` through the shell;
+    - ``*.py`` sources run under the current Python interpreter;
+    - runtime nodes (operators) run the operator-runtime module;
+    - anything else is an executable path or $PATH name.
+    """
+    if isinstance(node.kind, RuntimeNode):
+        return [sys.executable, "-m", "dora_tpu.runtime"]
+    custom: CustomNode = node.kind
+    source = custom.source
+    args = shlex.split(custom.args) if custom.args else []
+    if source == SHELL_SOURCE:
+        return custom.args or ""
+    if source.startswith("module:"):
+        # TPU-build addition: run an installed Python module as the node
+        # (equivalent of the reference node-hub's console-script entries).
+        return [sys.executable, "-m", source[len("module:"):]] + args
+    if source.endswith(".py"):
+        path = Path(source)
+        if not path.is_absolute():
+            path = working_dir / path
+        return [sys.executable, str(path)] + args
+    path = Path(source)
+    if not path.is_absolute():
+        local = working_dir / path
+        if local.exists():
+            return [str(local)] + args
+    return [source] + args
+
+
+async def spawn_node(
+    daemon: "Daemon",
+    df: "DataflowState",
+    node: ResolvedNode,
+    node_config: NodeConfig,
+) -> asyncio.subprocess.Process:
+    """Spawn one node process with its config injected via the environment."""
+    working_dir = df.working_dir
+    cmd = resolve_command(node, working_dir)
+
+    env = dict(os.environ)
+    env.update({str(k): str(v) for k, v in node.env.items()})
+    env[NODE_CONFIG_ENV] = encode_node_config(node_config)
+    # Nodes importing dora_tpu from a source checkout need the repo root.
+    repo_root = str(Path(__file__).resolve().parent.parent.parent)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    if isinstance(node.kind, RuntimeNode):
+        env["DORA_RUNTIME_NODE"] = "1"
+
+    kwargs = dict(
+        cwd=str(working_dir),
+        env=env,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+    )
+    if isinstance(cmd, str):
+        process = await asyncio.create_subprocess_shell(cmd, **kwargs)
+    else:
+        try:
+            process = await asyncio.create_subprocess_exec(*cmd, **kwargs)
+        except FileNotFoundError as e:
+            raise RuntimeError(f"node {node.id!r}: cannot spawn {cmd[0]!r}: {e}") from e
+
+    log_path = log_file_path(working_dir, df.id, str(node.id))
+    log_path.parent.mkdir(parents=True, exist_ok=True)
+    log_file = open(log_path, "ab")
+
+    pumps = [
+        asyncio.create_task(
+            _pump_stream(daemon, df, node, process.stdout, log_file, is_stderr=False)
+        ),
+        asyncio.create_task(
+            _pump_stream(daemon, df, node, process.stderr, log_file, is_stderr=True)
+        ),
+    ]
+    asyncio.create_task(_watch_exit(daemon, df, node, process, log_file, pumps))
+    return process
+
+
+async def _pump_stream(daemon, df, node, stream, log_file, *, is_stderr: bool):
+    send_as = node.send_stdout_as
+    while True:
+        try:
+            line = await stream.readline()
+        except (ValueError, ConnectionError):
+            # Over-long line without newline: fall back to raw chunks.
+            try:
+                line = await stream.read(1 << 16)
+            except Exception:
+                break
+        if not line:
+            break
+        try:
+            log_file.write(line)
+            log_file.flush()
+        except ValueError:
+            break  # log file closed during shutdown
+        text = line.decode(errors="replace").rstrip("\n")
+        if is_stderr:
+            ring = df.stderr_rings.setdefault(str(node.id), [])
+            ring.append(text)
+            del ring[:-STDERR_RING_LINES]
+        daemon.on_node_log(df, str(node.id), "error" if is_stderr else "info", text)
+        if not is_stderr and send_as:
+            daemon.publish_stdout_line(df, node.id, send_as, text)
+
+
+async def _watch_exit(daemon, df, node, process, log_file, pumps):
+    returncode = await process.wait()
+    # Drain stdout/stderr fully before the result is classified (the stderr
+    # ring and send_stdout_as republishing must see every line).
+    try:
+        await asyncio.wait_for(asyncio.gather(*pumps), timeout=10)
+    except (asyncio.TimeoutError, Exception):
+        pass
+    try:
+        log_file.close()
+    except Exception:
+        pass
+    daemon.handle_node_exit(df, node.id, returncode)

@@ -38,6 +38,14 @@ class NodeConfigReply:
     node_config: Any = None  # NodeConfig
 
 
+@message
+class DropEvents:
+    """Reply to NextDropEvents: drop tokens whose shared-memory regions are
+    free for the owning node to reuse (empty list only on stream close)."""
+
+    drop_tokens: list[str]
+
+
 # ---------------------------------------------------------------------------
 # Events
 # ---------------------------------------------------------------------------
@@ -92,13 +100,13 @@ class UnixDomainCommunication:
 
 @message
 class ShmemCommunication:
-    """Four shared-memory request-reply regions, exactly like the reference
-    (daemon_to_node.rs:13-44): control, events, drop, events-close-signal."""
+    """Shared-memory request-reply regions (reference uses four,
+    daemon_to_node.rs:13-44; we fold the close signal into the channel's
+    own disconnect protocol, so three regions suffice)."""
 
     control_region_id: str
     events_region_id: str
     drop_region_id: str
-    events_close_region_id: str
 
 
 DaemonCommunication = TcpCommunication | UnixDomainCommunication | ShmemCommunication

@@ -14,14 +14,24 @@ from dora_tpu.message.common import Metadata
 from dora_tpu.message.serde import message
 
 
+#: Channel kinds a node opens to its daemon.
+CHANNEL_CONTROL = "control"
+CHANNEL_EVENTS = "events"
+CHANNEL_DROP = "drop"
+
+
 @message
 class Register:
     """First message on every node channel; daemon checks protocol version
-    compatibility and replies Result."""
+    compatibility and replies Result. ``channel`` tells the daemon which of
+    the three per-node channels this connection carries (the reference
+    spawns one listener per connection and infers the role from the first
+    request; an explicit discriminator keeps one TCP/UDS accept loop)."""
 
     dataflow_id: str
     node_id: str
     protocol_version: str
+    channel: str = CHANNEL_CONTROL
 
 
 @message
@@ -68,6 +78,12 @@ class ReportDropTokens:
     """Out-of-band drop-token ack (used by the drop stream). No reply."""
 
     drop_tokens: list[str]
+
+
+@message
+class NextDropEvents:
+    """Blocking poll on the drop channel for released drop tokens (regions
+    of ours that no receiver references anymore)."""
 
 
 @message

@@ -20,16 +20,36 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 
 
+def _src_digest() -> str:
+    import hashlib
+
+    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+
+
 def build_native(force: bool = False) -> Path:
-    """Compile native/shmem.cpp to dora_tpu/_native.so if needed."""
+    """Compile native/shmem.cpp to dora_tpu/_native.so if needed.
+
+    Staleness is keyed on a source-content hash (mtime lies after git
+    checkouts), and the build publishes atomically (temp file +
+    os.replace) so concurrent first-use imports in spawned node processes
+    never dlopen a half-written library.
+    """
+    stamp = _HERE / "_native.build-id"
+    digest = _src_digest()
     if _LIB.exists() and not force:
-        if not _SRC.exists() or _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        if stamp.exists() and stamp.read_text().strip() == digest:
             return _LIB
+    tmp = _HERE / f"_native.{os.getpid()}.tmp.so"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", str(_LIB), str(_SRC), "-lrt", "-pthread",
+        "-o", str(tmp), str(_SRC), "-lrt", "-pthread",
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
+        stamp.write_text(digest)
+    finally:
+        tmp.unlink(missing_ok=True)
     return _LIB
 
 
@@ -198,6 +218,8 @@ class ShmemChannel:
         return cls(h, name, is_server=False)
 
     def send(self, data: bytes) -> None:
+        if not self._h:
+            raise ShmemError(f"channel {self.name} is closed")
         rc = self._lib.dtp_channel_send(
             self._h, data, len(data), 1 if self.is_server else 0
         )
@@ -212,6 +234,8 @@ class ShmemChannel:
 
     def recv(self, timeout: float | None = None) -> bytes | None:
         """Receive one message; None on timeout; raises Disconnected."""
+        if not self._h:
+            raise ShmemError(f"channel {self.name} is closed")
         timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
         n = self._lib.dtp_channel_recv(
             self._h,
@@ -232,6 +256,8 @@ class ShmemChannel:
 
     @property
     def disconnected(self) -> bool:
+        if not self._h:
+            return True
         return bool(self._lib.dtp_channel_is_disconnected(self._h))
 
     def disconnect(self) -> None:

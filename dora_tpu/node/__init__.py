@@ -1,0 +1,373 @@
+"""The Python node API: ``dora_tpu.Node``.
+
+Reference parity: apis/rust/node (DoraNode + EventStream + DropStream) and
+apis/python/node (the `dora.Node` pyclass shape): construct from the
+environment (spawned nodes) or by node id (dynamic nodes), iterate events,
+``send_output`` with zero-copy shared memory for payloads ≥ 4 KiB.
+
+Usage::
+
+    from dora_tpu import Node
+
+    node = Node()
+    for event in node:
+        if event["type"] == "INPUT":
+            node.send_output("out", event["value"])
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Any
+
+from dora_tpu.clock import HLC
+from dora_tpu.core.topics import (
+    DORA_DAEMON_LOCAL_LISTEN_PORT_DEFAULT,
+    ZERO_COPY_THRESHOLD,
+)
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message import node_to_daemon as n2d
+from dora_tpu.message.common import (
+    ENCODING_ARROW_IPC,
+    ENCODING_RAW,
+    InlineData,
+    Metadata,
+    SharedMemoryData,
+    TypeInfo,
+    new_drop_token,
+)
+from dora_tpu.message.serde import decode_timestamped, encode_timestamped
+from dora_tpu.native import ShmemRegion
+from dora_tpu.node.channels import DaemonChannel, DaemonError
+from dora_tpu.node.events import Event, EventStream
+from dora_tpu.transport.framing import recv_frame, send_frame
+
+#: Max cached reusable shmem regions per node
+#: (reference: apis/rust/node/src/node/mod.rs:365).
+SHMEM_CACHE_REGIONS = 20
+
+#: On close, wait this long for receivers to release our regions
+#: (reference: mod.rs:405).
+DROP_TOKEN_WAIT_S = 10.0
+
+
+class _DropStream:
+    """Background thread receiving released drop tokens (our regions that no
+    receiver references anymore)."""
+
+    def __init__(self, channel: DaemonChannel, on_tokens):
+        self._channel = channel
+        self._on_tokens = on_tokens
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dora-drop-stream", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._closed.is_set():
+                reply = self._channel.request(n2d.NextDropEvents())
+                if not isinstance(reply, d2n.DropEvents) or not reply.drop_tokens:
+                    break
+                self._on_tokens(reply.drop_tokens)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._channel.interrupt()  # wake the thread if parked in recv
+        except Exception:
+            pass
+        self._thread.join(timeout=2)
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+
+
+class Node:
+    """One dataflow node (spawned by the daemon, or dynamic)."""
+
+    def __init__(self, node_id: str | None = None, daemon_addr: str | None = None):
+        config = self._load_config(node_id, daemon_addr)
+        self._config = config
+        self.dataflow_id = config.dataflow_id
+        self.node_id = config.node_id
+        self._clock = HLC()
+        comm = config.daemon_communication
+
+        self._control = DaemonChannel.connect(
+            comm, n2d.CHANNEL_CONTROL, config.dataflow_id, config.node_id, self._clock
+        )
+
+        # Sender-side shmem region bookkeeping.
+        self._regions_lock = threading.Lock()
+        self._regions_in_use: dict[str, ShmemRegion] = {}  # token -> region
+        self._regions_free: list[ShmemRegion] = []
+        self._finished_unreported: list[str] = []
+
+        drop_channel = DaemonChannel.connect(
+            comm, n2d.CHANNEL_DROP, config.dataflow_id, config.node_id, self._clock
+        )
+        drop_channel.request_ok(n2d.SubscribeDrop())
+        self._drop_stream = _DropStream(drop_channel, self._reclaim_regions)
+
+        # Ack flusher: receiver-side drop-token acks are queued by GC
+        # finalizers and flushed as ReportDropTokens on the control channel.
+        self._ack_cond = threading.Condition()
+        self._pending_acks: list[str] = []
+        self._ack_closing = False
+        self._ack_thread = threading.Thread(
+            target=self._ack_loop, name="dora-ack-flusher", daemon=True
+        )
+        self._ack_thread.start()
+
+        events_channel = DaemonChannel.connect(
+            comm, n2d.CHANNEL_EVENTS, config.dataflow_id, config.node_id, self._clock
+        )
+        # Blocks until every node of the dataflow subscribed (start barrier).
+        events_channel.request_ok(n2d.Subscribe())
+        self._events = EventStream(events_channel, on_ack=self._queue_ack)
+
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # bootstrap
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _load_config(node_id: str | None, daemon_addr: str | None) -> d2n.NodeConfig:
+        from dora_tpu.daemon.spawn import NODE_CONFIG_ENV, decode_node_config
+
+        raw = os.environ.get(NODE_CONFIG_ENV)
+        if raw and node_id is None:
+            return decode_node_config(raw)
+        if node_id is None:
+            raise RuntimeError(
+                "Node() must be started by a daemon (DORA_NODE_CONFIG is not "
+                "set); pass node_id=... for a dynamic node"
+            )
+        # Dynamic node: fetch the config from the daemon's local listen port
+        # (reference: apis/rust/node/src/node/mod.rs:87-110).
+        addr = daemon_addr or f"127.0.0.1:{DORA_DAEMON_LOCAL_LISTEN_PORT_DEFAULT}"
+        host, _, port = addr.rpartition(":")
+        clock = HLC()
+        with socket.create_connection((host, int(port)), timeout=10) as sock:
+            send_frame(
+                sock, encode_timestamped(n2d.NodeConfigRequest(node_id=node_id), clock)
+            )
+            reply = decode_timestamped(recv_frame(sock), clock).inner
+        if not isinstance(reply, d2n.NodeConfigReply):
+            raise RuntimeError(f"unexpected reply {type(reply).__name__}")
+        if reply.error:
+            raise RuntimeError(f"dynamic node init failed: {reply.error}")
+        return reply.node_config
+
+    # ------------------------------------------------------------------
+    # events
+    # ------------------------------------------------------------------
+
+    def recv(self, timeout: float | None = None) -> Event | None:
+        """Next event; None when the stream ended or ``timeout`` expired."""
+        return self._events.recv(timeout)
+
+    #: dora Python API compatibility alias.
+    next = recv
+
+    def __iter__(self):
+        return iter(self._events)
+
+    def __next__(self) -> Event:
+        event = self._events.recv()
+        if event is None:
+            raise StopIteration
+        return event
+
+    # ------------------------------------------------------------------
+    # outputs
+    # ------------------------------------------------------------------
+
+    def send_output(
+        self,
+        output_id: str,
+        data: Any = None,
+        metadata: dict | None = None,
+    ) -> None:
+        """Publish one output. ``data`` may be a pyarrow array, numpy array,
+        list, bytes, or None; payloads ≥ 4 KiB travel via shared memory."""
+        if output_id not in self._config.run_config.outputs:
+            raise DaemonError(
+                f"node {self.node_id!r} has no output {output_id!r} "
+                f"(declared: {self._config.run_config.outputs})"
+            )
+        params = dict(metadata or {})
+
+        if data is None:
+            type_info = TypeInfo(encoding=ENCODING_RAW, len=0)
+            message_data: Any = None
+        elif isinstance(data, (bytes, bytearray, memoryview)):
+            raw = bytes(data)
+            type_info = TypeInfo(encoding=ENCODING_RAW, len=len(raw))
+            message_data = self._pack_payload_raw(raw)
+        else:
+            from dora_tpu.node.arrow import (
+                ipc_max_size,
+                ipc_serialize,
+                ipc_serialize_into,
+                to_arrow,
+            )
+
+            arr = to_arrow(data)
+            max_size = ipc_max_size(arr)
+            if max_size >= ZERO_COPY_THRESHOLD:
+                region, token = self._alloc_region(max_size)
+                written = ipc_serialize_into(arr, memoryview(region))
+                message_data = SharedMemoryData(
+                    shmem_id=region.name, len=written, drop_token=token
+                )
+                type_info = TypeInfo(encoding=ENCODING_ARROW_IPC, len=written)
+            else:
+                payload = ipc_serialize(arr)
+                type_info = TypeInfo(encoding=ENCODING_ARROW_IPC, len=len(payload))
+                message_data = InlineData(data=payload)
+
+        self._control.request(
+            n2d.SendMessage(
+                output_id=output_id,
+                metadata=Metadata(type_info=type_info, parameters=params),
+                data=message_data,
+            )
+        )
+
+    def _pack_payload_raw(self, raw: bytes) -> Any:
+        if len(raw) >= ZERO_COPY_THRESHOLD:
+            region, token = self._alloc_region(len(raw))
+            memoryview(region)[: len(raw)] = raw
+            return SharedMemoryData(
+                shmem_id=region.name, len=len(raw), drop_token=token
+            )
+        return InlineData(data=raw)
+
+    # ------------------------------------------------------------------
+    # shared-memory region cache (reference: mod.rs:303-371)
+    # ------------------------------------------------------------------
+
+    def _alloc_region(self, size: int) -> tuple[ShmemRegion, str]:
+        token = new_drop_token()
+        with self._regions_lock:
+            for i, region in enumerate(self._regions_free):
+                if region.size >= size:
+                    del self._regions_free[i]
+                    self._regions_in_use[token] = region
+                    return region, token
+        # Round up to reduce fragmentation across varying payload sizes.
+        alloc = max(4096, 1 << (size - 1).bit_length())
+        region = ShmemRegion.create(f"dtp-{uuid.uuid4().hex[:16]}", alloc)
+        with self._regions_lock:
+            self._regions_in_use[token] = region
+        return region, token
+
+    def _queue_ack(self, token: str) -> None:
+        with self._ack_cond:
+            self._pending_acks.append(token)
+            self._ack_cond.notify()
+
+    def _ack_loop(self) -> None:
+        while True:
+            with self._ack_cond:
+                while not self._pending_acks and not self._ack_closing:
+                    self._ack_cond.wait()
+                if self._ack_closing and not self._pending_acks:
+                    return
+                tokens, self._pending_acks = self._pending_acks, []
+            try:
+                self._control.request(n2d.ReportDropTokens(drop_tokens=tokens))
+            except Exception:
+                return
+
+    def _reclaim_regions(self, tokens: list[str]) -> None:
+        with self._regions_lock:
+            for token in tokens:
+                region = self._regions_in_use.pop(token, None)
+                if region is None:
+                    continue
+                if len(self._regions_free) < SHMEM_CACHE_REGIONS:
+                    self._regions_free.append(region)
+                else:
+                    try:
+                        region.close(unlink=True, force=True)
+                    except Exception:
+                        pass
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+
+    def dataflow_descriptor(self) -> dict:
+        return self._config.dataflow_descriptor
+
+    def dataflow_id_str(self) -> str:
+        return self.dataflow_id
+
+    @property
+    def config(self) -> d2n.NodeConfig:
+        return self._config
+
+    def close(self) -> None:
+        """Report outputs done, wait for receivers to release our regions
+        (≤ 10 s), tear down channels."""
+        if self._closed:
+            return
+        self._closed = True
+        # Surface straggler events so their finalizers queue acks, then let
+        # the flusher drain before we report done.
+        self._events.close()
+        with self._ack_cond:
+            self._ack_closing = True
+            self._ack_cond.notify()
+        self._ack_thread.join(timeout=2)
+        try:
+            self._control.request_ok(n2d.OutputsDone())
+        except Exception:
+            pass
+        deadline = time.monotonic() + DROP_TOKEN_WAIT_S
+        while time.monotonic() < deadline:
+            with self._regions_lock:
+                if not self._regions_in_use:
+                    break
+            time.sleep(0.05)
+        self._drop_stream.close()
+        self._events.close()
+        try:
+            self._control.close()
+        except Exception:
+            pass
+        with self._regions_lock:
+            for region in list(self._regions_in_use.values()) + self._regions_free:
+                try:
+                    region.close(unlink=True, force=True)
+                except Exception:
+                    pass
+            self._regions_in_use.clear()
+            self._regions_free.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+__all__ = ["Node", "Event", "DaemonError"]

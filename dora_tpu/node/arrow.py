@@ -1,0 +1,84 @@
+"""Arrow payload (de)serialization for the data plane.
+
+Design difference from the reference (apis/rust/node/src/node/arrow_utils.rs):
+instead of a hand-rolled buffer-offset table we use standard **Arrow IPC
+stream format**. pyarrow serializes an array *directly into* a mapped
+shared-memory region (one producer-side copy, exactly like the reference)
+and deserializes it **zero-copy** — the resulting arrays view the mapped
+region; no receiver-side copy happens.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pyarrow as pa
+
+#: Field name used when wrapping a bare array into a record batch for IPC.
+_FIELD = "data"
+
+#: Room for the schema message + framing around the batch message.
+_IPC_OVERHEAD = 1024
+
+
+def to_arrow(data: Any) -> pa.Array:
+    """Coerce user data to an Arrow array (numpy arrays zero-copy)."""
+    if isinstance(data, pa.Array):
+        return data
+    if isinstance(data, pa.ChunkedArray):
+        return data.combine_chunks()
+    try:
+        import numpy as np
+
+        if isinstance(data, np.ndarray):
+            if data.ndim != 1:
+                data = data.ravel()
+            return pa.array(data)
+    except ImportError:  # pragma: no cover
+        pass
+    return pa.array(data)
+
+
+def _as_batch(arr: pa.Array) -> pa.RecordBatch:
+    return pa.record_batch([arr], names=[_FIELD])
+
+
+def ipc_max_size(arr: pa.Array) -> int:
+    """Upper bound on the IPC stream size for one array."""
+    return pa.ipc.get_record_batch_size(_as_batch(arr)) + _IPC_OVERHEAD
+
+
+def ipc_serialize(arr: pa.Array) -> bytes:
+    sink = pa.BufferOutputStream()
+    batch = _as_batch(arr)
+    with pa.ipc.new_stream(sink, batch.schema) as writer:
+        writer.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_serialize_into(arr: pa.Array, buf: memoryview) -> int:
+    """Serialize directly into a writable buffer (a mapped shmem region);
+    returns the number of bytes written."""
+    batch = _as_batch(arr)
+    sink = pa.FixedSizeBufferWriter(pa.py_buffer(buf))
+    with pa.ipc.new_stream(sink, batch.schema) as writer:
+        writer.write_batch(batch)
+    return sink.tell()
+
+
+def ipc_deserialize(buf: Any) -> pa.Array:
+    """Zero-copy read of one array from an IPC stream (bytes or memoryview —
+    the arrays keep the underlying buffer alive via pyarrow's foreign-buffer
+    reference)."""
+    reader = pa.ipc.open_stream(pa.py_buffer(buf))
+    table = reader.read_all()
+    column = table.column(0)
+    if column.num_chunks == 1:
+        return column.chunk(0)
+    return column.combine_chunks()
+
+
+def ipc_bytes_str(text: str) -> bytes:
+    """One-line helper: a single utf8 string as an IPC payload (used by the
+    daemon's ``send_stdout_as`` republishing)."""
+    return ipc_serialize(pa.array([text]))

@@ -1,0 +1,145 @@
+"""Node-side daemon channels (synchronous — nodes are synchronous by design).
+
+Reference parity: apis/rust/node/src/daemon_connection/mod.rs — a
+``DaemonChannel`` abstracts over TCP, UDS, and the native shared-memory
+request-reply channel; every channel starts with a Register exchange.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any
+
+from dora_tpu import PROTOCOL_VERSION
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message import node_to_daemon as n2d
+from dora_tpu.message.serde import decode_timestamped, encode_timestamped
+from dora_tpu.native import Disconnected, ShmemChannel
+from dora_tpu.transport.framing import recv_frame, send_frame
+
+
+class DaemonError(RuntimeError):
+    """The daemon rejected a request."""
+
+
+class _SocketTransport:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def send(self, payload: bytes) -> None:
+        send_frame(self.sock, payload)
+
+    def recv(self) -> bytes:
+        return recv_frame(self.sock)
+
+    def interrupt(self) -> None:
+        """Wake any thread blocked in recv (socket stays closeable later)."""
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.interrupt()
+        self.sock.close()
+
+
+class _ShmemTransport:
+    def __init__(self, channel: ShmemChannel):
+        self.channel = channel
+
+    def send(self, payload: bytes) -> None:
+        self.channel.send(payload)
+
+    def recv(self) -> bytes:
+        data = self.channel.recv(timeout=None)
+        if data is None:  # pragma: no cover - no-timeout recv returns data
+            raise Disconnected("shmem channel closed")
+        return data
+
+    def interrupt(self) -> None:
+        """Set the disconnect flag — wakes blocked recv with Disconnected
+        WITHOUT freeing the native handle (freeing under a blocked recv is a
+        use-after-free; call close() only after the blocked thread exited)."""
+        self.channel.disconnect()
+
+    def close(self) -> None:
+        self.channel.disconnect()
+        self.channel.close()
+
+
+class DaemonChannel:
+    """One registered request-reply channel to the daemon."""
+
+    def __init__(self, transport, clock):
+        self._transport = transport
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.closed = False
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def connect(
+        cls, comm: Any, channel_kind: str, dataflow_id: str, node_id: str, clock
+    ) -> "DaemonChannel":
+        if isinstance(comm, d2n.TcpCommunication):
+            host, _, port = comm.socket_addr.rpartition(":")
+            sock = socket.create_connection((host, int(port)))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            transport: Any = _SocketTransport(sock)
+        elif isinstance(comm, d2n.UnixDomainCommunication):
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(comm.socket_file)
+            transport = _SocketTransport(sock)
+        elif isinstance(comm, d2n.ShmemCommunication):
+            region = {
+                n2d.CHANNEL_CONTROL: comm.control_region_id,
+                n2d.CHANNEL_EVENTS: comm.events_region_id,
+                n2d.CHANNEL_DROP: comm.drop_region_id,
+            }[channel_kind]
+            transport = _ShmemTransport(ShmemChannel.open(region))
+        else:
+            raise ValueError(f"unknown daemon communication {comm!r}")
+        channel = cls(transport, clock)
+        reply = channel.request(
+            n2d.Register(
+                dataflow_id=dataflow_id,
+                node_id=node_id,
+                protocol_version=PROTOCOL_VERSION,
+                channel=channel_kind,
+            )
+        )
+        if isinstance(reply, d2n.ReplyResult) and reply.error:
+            channel.close()
+            raise DaemonError(f"register failed: {reply.error}")
+        return channel
+
+    # -- requests -----------------------------------------------------------
+
+    def request(self, msg: Any) -> Any:
+        """Send one request and (if the message type expects it) wait for the
+        reply."""
+        with self._lock:
+            self._transport.send(encode_timestamped(msg, self._clock))
+            if not n2d.expects_reply(msg):
+                return None
+            frame = self._transport.recv()
+        return decode_timestamped(frame, self._clock).inner
+
+    def request_ok(self, msg: Any) -> None:
+        reply = self.request(msg)
+        if isinstance(reply, d2n.ReplyResult) and reply.error:
+            raise DaemonError(reply.error)
+
+    def interrupt(self) -> None:
+        """Phase 1 of shutdown: unblock any thread parked in recv."""
+        self._transport.interrupt()
+
+    def close(self) -> None:
+        """Phase 2: free the transport. Must not race a blocked recv — call
+        interrupt() and join the consuming thread first."""
+        if not self.closed:
+            self.closed = True
+            self._transport.close()

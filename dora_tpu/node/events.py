@@ -1,0 +1,222 @@
+"""Node-side events: the user-facing Event object and the stream thread.
+
+Reference parity: apis/rust/node/src/event_stream — a background thread
+runs the blocking NextEvent loop, reconstructs zero-copy Arrow views over
+mapped shared-memory regions, and piggybacks drop-token acknowledgements
+for events the user code has dropped.
+
+The Python Event mirrors the reference's Python dict shape
+(apis/python/operator/src/lib.rs PyEvent): ``event["type"]`` in
+{"INPUT","INPUT_CLOSED","STOP","RELOAD","ERROR"}, plus ``id``, ``value``
+(pyarrow array, zero-copy), ``metadata``.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import weakref
+from typing import Any
+
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message import node_to_daemon as n2d
+from dora_tpu.message.common import (
+    ENCODING_ARROW_IPC,
+    InlineData,
+    SharedMemoryData,
+)
+from dora_tpu.native import ShmemRegion
+
+
+class Event:
+    """One dataflow event. Dict-like for dora API compatibility."""
+
+    __slots__ = ("type", "id", "value", "metadata", "error", "operator_id",
+                 "_ack", "__weakref__")
+
+    def __init__(self, type: str, id: str | None = None, value: Any = None,
+                 metadata: dict | None = None, error: str | None = None,
+                 operator_id: str | None = None):
+        self.type = type
+        self.id = id
+        self.value = value
+        self.metadata = metadata or {}
+        self.error = error
+        self.operator_id = operator_id
+        self._ack = None
+
+    def __getitem__(self, key: str) -> Any:
+        try:
+            return getattr(self, key)
+        except AttributeError:
+            raise KeyError(key) from None
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return getattr(self, key, default)
+
+    def __contains__(self, key: str) -> bool:
+        return hasattr(self, key) and getattr(self, key) is not None
+
+    def __repr__(self) -> str:
+        parts = [f"type={self.type!r}"]
+        if self.id:
+            parts.append(f"id={self.id!r}")
+        if self.error:
+            parts.append(f"error={self.error!r}")
+        return f"Event({', '.join(parts)})"
+
+
+class EventStream:
+    """Background thread pumping the blocking NextEvent loop.
+
+    ``on_ack(token)`` is called (from arbitrary threads — GC finalizers)
+    when user code drops an event whose payload lives in shared memory; the
+    Node flushes those acks to the daemon out-of-band via ReportDropTokens
+    on the control channel (the NextEvent piggyback the reference uses
+    would strand the final ack: the pump is already parked inside the next
+    blocking NextEvent when the user drops the last event).
+    """
+
+    def __init__(self, channel, on_ack=None, max_queue: int = 0):
+        self._channel = channel
+        self._on_ack = on_ack
+        self._queue: queue_mod.Queue = queue_mod.Queue(max_queue)
+        self._pending_acks: list[str] = []
+        self._acks_lock = threading.Lock()
+        self._closed = threading.Event()
+        #: shmem_id -> mapped region (kept mapped for the stream's lifetime;
+        #: senders never reuse a region name after unlinking, so a cached
+        #: mapping can never go stale)
+        self._regions: dict[str, ShmemRegion] = {}
+        self._thread = threading.Thread(
+            target=self._run, name="dora-event-stream", daemon=True
+        )
+        self._thread.start()
+
+    # -- user side ----------------------------------------------------------
+
+    def recv(self, timeout: float | None = None) -> Event | None:
+        """Next event, or None when the stream ended (or timeout expired)."""
+        if self._closed.is_set() and self._queue.empty():
+            return None
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue_mod.Empty:
+            return None
+        if item is None:
+            self._closed.set()
+            return None
+        return item
+
+    def __iter__(self):
+        while True:
+            event = self.recv()
+            if event is None:
+                return
+            yield event
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._channel.interrupt()  # wake the pump if parked in recv
+        except Exception:
+            pass
+        self._thread.join(timeout=2)
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+        for region in self._regions.values():
+            try:
+                # Never force: user code may still hold zero-copy arrays into
+                # the region; unmapping under them would segfault. Regions
+                # with live views stay mapped until process exit.
+                region.close(unlink=False)
+            except Exception:
+                pass
+        self._regions.clear()
+
+    # -- pump thread --------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._closed.is_set():
+                with self._acks_lock:
+                    acks, self._pending_acks = self._pending_acks, []
+                reply = self._channel.request(n2d.NextEvent(drop_tokens=acks))
+                if not isinstance(reply, d2n.NextEvents) or not reply.events:
+                    break
+                for ts in reply.events:
+                    event = self._convert(ts.inner)
+                    if event is not None:
+                        self._queue.put(event)
+        except Exception as e:
+            if not self._closed.is_set():
+                self._queue.put(Event(type="ERROR", error=str(e)))
+        finally:
+            self._queue.put(None)
+
+    def _convert(self, inner: Any) -> Event | None:
+        if isinstance(inner, d2n.Input):
+            value, token = self._reconstruct(inner)
+            event = Event(
+                type="INPUT",
+                id=inner.id,
+                value=value,
+                metadata=dict(inner.metadata.parameters),
+            )
+            if token is not None:
+                # Ack when the user drops the event (CPython refcounting
+                # makes this prompt); the sender then reuses the region.
+                event._ack = weakref.finalize(
+                    event, self._queue_ack, token
+                )
+            return event
+        if isinstance(inner, d2n.InputClosed):
+            return Event(type="INPUT_CLOSED", id=inner.id)
+        if isinstance(inner, d2n.AllInputsClosed):
+            self._closed.set()
+            return None
+        if isinstance(inner, d2n.Stop):
+            return Event(type="STOP")
+        if isinstance(inner, d2n.Reload):
+            return Event(type="RELOAD", operator_id=inner.operator_id)
+        return None
+
+    def _queue_ack(self, token: str) -> None:
+        if self._on_ack is not None:
+            try:
+                self._on_ack(token)
+            except Exception:
+                pass
+            return
+        with self._acks_lock:
+            self._pending_acks.append(token)
+
+    def _reconstruct(self, inner: d2n.Input) -> tuple[Any, str | None]:
+        """Rebuild the payload value; zero-copy for shared-memory data."""
+        from dora_tpu.node.arrow import ipc_deserialize
+
+        data = inner.data
+        encoding = inner.metadata.type_info.encoding
+        if data is None:
+            return None, None
+        if isinstance(data, InlineData):
+            raw: Any = data.data
+            if encoding == ENCODING_ARROW_IPC:
+                return ipc_deserialize(raw), None
+            return raw, None
+        assert isinstance(data, SharedMemoryData)
+        region = self._regions.get(data.shmem_id)
+        if region is None:
+            region = ShmemRegion.open(data.shmem_id)
+            self._regions[data.shmem_id] = region
+        view = memoryview(region)[: data.len]
+        if encoding == ENCODING_ARROW_IPC:
+            # The arrays hold the memoryview via pyarrow's foreign buffer,
+            # which pins the region's export count until they are dropped.
+            value: Any = ipc_deserialize(view)
+        else:
+            value = bytes(view)  # raw bytes: copy out, ack immediately
+            view.release()
+        return value, data.drop_token

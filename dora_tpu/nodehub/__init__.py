@@ -1,0 +1,11 @@
+"""Prepackaged nodes (the node hub).
+
+Reference parity: node-hub/* (SURVEY.md §2.4). Each module exposes
+``main()`` and is runnable as ``path: module:dora_tpu.nodehub.<name>`` in a
+dataflow YAML (the TPU build's equivalent of the reference's console-script
+entry points).
+
+Test fixtures: pyarrow_sender / pyarrow_assert / echo
+(reference: node-hub/pyarrow-sender, pyarrow-assert, dora-echo).
+AI/I/O nodes live in sibling modules (camera, detection, vlm, asr, …).
+"""

@@ -1,0 +1,22 @@
+"""Test-fixture node: echo every input back out.
+
+Reference parity: node-hub/dora-echo — republishes each input value on the
+``echo`` output.
+"""
+
+from __future__ import annotations
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    with Node() as node:
+        for event in node:
+            if event["type"] == "INPUT":
+                node.send_output("echo", event["value"], event["metadata"])
+            elif event["type"] == "STOP":
+                break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Test-fixture node: send a literal pyarrow value.
+
+Reference parity: node-hub/pyarrow-sender — sends the Python literal from
+the ``DATA`` env var as one output, then exits.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+import pyarrow as pa
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    data = ast.literal_eval(os.environ.get("DATA", "[1, 2, 3]"))
+    count = int(os.environ.get("COUNT", "1"))
+    with Node() as node:
+        for _ in range(count):
+            node.send_output("data", pa.array(data if isinstance(data, list) else [data]))
+
+
+if __name__ == "__main__":
+    main()

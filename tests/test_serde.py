@@ -102,8 +102,7 @@ def test_node_config_roundtrip():
         node_id="cam",
         run_config=RunConfig(inputs={}, outputs=[]),
         daemon_communication=ShmemCommunication(
-            control_region_id="a", events_region_id="b",
-            drop_region_id="c", events_close_region_id="d",
+            control_region_id="a", events_region_id="b", drop_region_id="c",
         ),
         dataflow_descriptor={},
     )
