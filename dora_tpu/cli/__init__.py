@@ -1,0 +1,8 @@
+"""The dora-tpu command-line interface.
+
+Reference parity: binaries/cli — `dora {new,build,check,graph,up,start,
+stop,logs,list,destroy,daemon,coordinator,runtime}` (src/main.rs:55-228).
+Like the reference, one binary embeds every role: `dora-tpu daemon` and
+`dora-tpu coordinator` run the data/control planes, so a single installed
+entry point can bring up a whole cluster.
+"""

@@ -1,0 +1,63 @@
+"""Synchronous control-port client (CLI <-> coordinator).
+
+Reference parity: the communication-layer request-reply TCP client
+(libraries/communication-layer/request-reply) as used by
+binaries/cli/src/main.rs:656-660.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any
+
+from dora_tpu.clock import HLC
+from dora_tpu.core.topics import DORA_COORDINATOR_PORT_CONTROL_DEFAULT
+from dora_tpu.message import coordinator as cm
+from dora_tpu.message.serde import decode_timestamped, encode_timestamped
+from dora_tpu.transport.framing import recv_frame, send_frame
+
+
+class ControlConnection:
+    def __init__(self, addr: str | None = None, timeout: float = 60.0):
+        addr = addr or f"127.0.0.1:{DORA_COORDINATOR_PORT_CONTROL_DEFAULT}"
+        host, _, port = addr.rpartition(":")
+        self._clock = HLC()
+        self.sock = socket.create_connection((host, int(port)), timeout=5)
+        self.sock.settimeout(timeout)
+
+    def request(self, msg: Any) -> Any:
+        send_frame(self.sock, encode_timestamped(msg, self._clock))
+        reply = decode_timestamped(recv_frame(self.sock), self._clock).inner
+        if isinstance(reply, cm.Error):
+            raise RuntimeError(reply.message)
+        return reply
+
+    def stream(self):
+        """After a LogSubscribe request: yield pushed messages."""
+        while True:
+            yield decode_timestamped(recv_frame(self.sock), self._clock).inner
+
+    def send_only(self, msg: Any) -> None:
+        send_frame(self.sock, encode_timestamped(msg, self._clock))
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def connect(addr: str | None = None) -> ControlConnection:
+    try:
+        return ControlConnection(addr)
+    except OSError as e:
+        raise SystemExit(
+            f"cannot connect to coordinator at {addr or 'localhost'}: {e}\n"
+            f"hint: run `dora-tpu up` first"
+        ) from e

@@ -1,0 +1,413 @@
+"""dora-tpu CLI entry point.
+
+Reference parity: binaries/cli/src/main.rs (clap command tree), up.rs
+(spawn/kill coordinator+daemon), attach.rs (poll + ctrl-c stop + log
+stream), build.rs, check.rs, graph.rs, logs.rs, template/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import yaml
+
+from dora_tpu import __version__
+from dora_tpu.core.topics import (
+    DORA_COORDINATOR_PORT_CONTROL_DEFAULT,
+    DORA_COORDINATOR_PORT_DEFAULT,
+)
+from dora_tpu.message import coordinator as cm
+
+PID_DIR = Path(os.environ.get("DORA_TPU_STATE_DIR", "/tmp/dora-tpu"))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _read_descriptor(path: str):
+    from dora_tpu.core.descriptor import Descriptor
+
+    return Descriptor.read(path)
+
+
+def _spawn_detached(args: list[str], log_name: str) -> int:
+    PID_DIR.mkdir(parents=True, exist_ok=True)
+    log = open(PID_DIR / f"{log_name}.log", "ab")
+    process = subprocess.Popen(
+        [sys.executable, "-m", "dora_tpu.cli.main"] + args,
+        stdout=log,
+        stderr=log,
+        start_new_session=True,
+    )
+    (PID_DIR / f"{log_name}.pid").write_text(str(process.pid))
+    return process.pid
+
+
+def _kill_pidfile(log_name: str) -> bool:
+    pidfile = PID_DIR / f"{log_name}.pid"
+    if not pidfile.exists():
+        return False
+    try:
+        os.kill(int(pidfile.read_text()), signal.SIGTERM)
+        killed = True
+    except (ProcessLookupError, ValueError):
+        killed = False
+    pidfile.unlink(missing_ok=True)
+    return killed
+
+
+def _control(args):
+    from dora_tpu.cli.control import connect
+
+    return connect(getattr(args, "coordinator_addr", None))
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_check(args) -> int:
+    descriptor = _read_descriptor(args.dataflow)
+    descriptor.check(Path(args.dataflow).parent)
+    print(f"{args.dataflow}: OK ({len(descriptor.nodes)} nodes)")
+    return 0
+
+
+def cmd_graph(args) -> int:
+    descriptor = _read_descriptor(args.dataflow)
+    mermaid = descriptor.visualize_as_mermaid()
+    if args.mermaid:
+        print(mermaid)
+    else:
+        html = (
+            "<!doctype html><html><body><pre class='mermaid'>\n"
+            + mermaid
+            + "\n</pre><script type='module'>import mermaid from "
+            "'https://cdn.jsdelivr.net/npm/mermaid@11/dist/mermaid.esm.min.mjs';"
+            "mermaid.initialize({startOnLoad:true});</script></body></html>"
+        )
+        out = Path(args.dataflow).with_suffix(".html")
+        out.write_text(html)
+        print(f"wrote {out}")
+    return 0
+
+
+def cmd_build(args) -> int:
+    """Run each node's / operator's `build:` command (reference: build.rs)."""
+    from dora_tpu.core.descriptor import CustomNode, RuntimeNode
+
+    descriptor = _read_descriptor(args.dataflow)
+    working_dir = Path(args.dataflow).resolve().parent
+    for node in descriptor.nodes:
+        builds = []
+        if isinstance(node.kind, CustomNode) and node.kind.build:
+            builds.append(node.kind.build)
+        elif isinstance(node.kind, RuntimeNode):
+            builds += [op.build for op in node.kind.operators if op.build]
+        for build in builds:
+            print(f"[{node.id}] {build}")
+            rc = subprocess.run(build, shell=True, cwd=working_dir).returncode
+            if rc != 0:
+                print(f"build of node {node.id!r} failed with {rc}", file=sys.stderr)
+                return rc
+    return 0
+
+
+def cmd_up(args) -> int:
+    """Spawn coordinator + daemon for this machine (reference: up.rs)."""
+    from dora_tpu.cli.control import ControlConnection
+
+    try:
+        with ControlConnection(args.coordinator_addr) as c:
+            c.request(cm.DaemonConnected())
+            print("coordinator + daemon already up")
+            return 0
+    except OSError:
+        pass
+    _spawn_detached(
+        ["coordinator", "--port", str(DORA_COORDINATOR_PORT_DEFAULT),
+         "--control-port", str(DORA_COORDINATOR_PORT_CONTROL_DEFAULT)],
+        "coordinator",
+    )
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            with ControlConnection(args.coordinator_addr) as c:
+                c.request(cm.DaemonConnected())
+            break
+        except OSError:
+            time.sleep(0.2)
+    else:
+        print("coordinator did not come up", file=sys.stderr)
+        return 1
+    _spawn_detached(
+        ["daemon", "--coordinator-addr",
+         args.coordinator_addr or f"127.0.0.1:{DORA_COORDINATOR_PORT_DEFAULT}"],
+        "daemon",
+    )
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with ControlConnection(args.coordinator_addr) as c:
+            if c.request(cm.DaemonConnected()).connected:
+                print("coordinator + daemon up")
+                return 0
+        time.sleep(0.2)
+    print("daemon did not register", file=sys.stderr)
+    return 1
+
+
+def cmd_destroy(args) -> int:
+    try:
+        with _control(args) as c:
+            c.request(cm.Destroy())
+            print("destroyed")
+    except SystemExit:
+        pass
+    _kill_pidfile("daemon")
+    _kill_pidfile("coordinator")
+    return 0
+
+
+def cmd_start(args) -> int:
+    raw = yaml.safe_load(Path(args.dataflow).read_text())
+    working_dir = str(Path(args.dataflow).resolve().parent)
+    with _control(args) as c:
+        reply = c.request(
+            cm.Start(dataflow=raw, name=args.name, local_working_dir=working_dir)
+        )
+        uuid = reply.uuid
+        print(uuid)
+        if not args.attach:
+            return 0
+        return _attach(c, uuid)
+
+
+def _attach(c, uuid: str) -> int:
+    """Poll Check until the dataflow finishes; ctrl-c requests a stop
+    (reference: attach.rs:20-209)."""
+    try:
+        while True:
+            reply = c.request(cm.Check(dataflow_uuid=uuid))
+            if isinstance(reply, cm.DataflowStopped):
+                return _print_result(reply.result)
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        print("\nstopping dataflow...")
+        reply = c.request(cm.StopRequest(dataflow_uuid=uuid, grace_duration_s=None))
+        return _print_result(reply.result)
+
+
+def _print_result(result) -> int:
+    if result.is_ok():
+        print(f"dataflow {result.uuid} finished successfully")
+        return 0
+    for node_id, error in result.errors():
+        print(f"node {node_id!r} failed: {error}", file=sys.stderr)
+    return 1
+
+
+def cmd_stop(args) -> int:
+    with _control(args) as c:
+        if args.name:
+            reply = c.request(
+                cm.StopByName(name=args.name, grace_duration_s=args.grace_duration)
+            )
+        elif args.uuid:
+            reply = c.request(
+                cm.StopRequest(dataflow_uuid=args.uuid, grace_duration_s=args.grace_duration)
+            )
+        else:
+            listed = c.request(cm.ListDataflows()).dataflows
+            if len(listed) != 1:
+                print(
+                    f"{len(listed)} dataflows running; pass --uuid or --name",
+                    file=sys.stderr,
+                )
+                return 1
+            reply = c.request(
+                cm.StopRequest(
+                    dataflow_uuid=listed[0].uuid, grace_duration_s=args.grace_duration
+                )
+            )
+        return _print_result(reply.result)
+
+
+def cmd_list(args) -> int:
+    with _control(args) as c:
+        for entry in c.request(cm.ListDataflows()).dataflows:
+            print(f"{entry.uuid}  {entry.name or ''}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    with _control(args) as c:
+        reply = c.request(cm.Logs(uuid=args.uuid, name=args.name, node=args.node))
+        sys.stdout.write(reply.logs.decode(errors="replace"))
+    return 0
+
+
+def cmd_coordinator(args) -> int:
+    from dora_tpu.coordinator import Coordinator
+
+    async def main():
+        coordinator = Coordinator()
+        await coordinator.start(daemon_port=args.port, control_port=args.control_port)
+        if not args.quiet:
+            print(
+                f"coordinator up (daemons: {coordinator.daemon_port}, "
+                f"control: {coordinator.control_port})"
+            )
+        await coordinator.wait_destroyed()
+        await coordinator.close()
+
+    asyncio.run(main())
+    return 0
+
+
+def cmd_daemon(args) -> int:
+    from dora_tpu.daemon.core import Daemon, run_dataflow_async
+
+    if args.run_dataflow:
+        async def standalone():
+            result = await run_dataflow_async(
+                args.run_dataflow, local_comm=args.local_comm
+            )
+            return _print_result(result)
+
+        return asyncio.run(standalone())
+
+    daemon = Daemon(local_comm=args.local_comm)
+    asyncio.run(daemon.run(args.coordinator_addr, args.machine_id))
+    return 0
+
+
+def cmd_runtime(args) -> int:
+    from dora_tpu.runtime.__main__ import main as runtime_main
+
+    runtime_main()
+    return 0
+
+
+def cmd_new(args) -> int:
+    from dora_tpu.cli.template import create
+
+    return create(args.kind, args.name, Path(args.path or args.name))
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dora-tpu", description="TPU-native dataflow framework CLI"
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def coordinator_addr(p):
+        p.add_argument(
+            "--coordinator-addr",
+            default=None,
+            help=f"control address (default 127.0.0.1:{DORA_COORDINATOR_PORT_CONTROL_DEFAULT})",
+        )
+
+    p = sub.add_parser("check", help="validate a dataflow YAML")
+    p.add_argument("dataflow")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("graph", help="visualize a dataflow as mermaid/HTML")
+    p.add_argument("dataflow")
+    p.add_argument("--mermaid", action="store_true", help="print mermaid source")
+    p.set_defaults(fn=cmd_graph)
+
+    p = sub.add_parser("build", help="run the build commands of all nodes")
+    p.add_argument("dataflow")
+    p.set_defaults(fn=cmd_build)
+
+    p = sub.add_parser("up", help="spawn coordinator + daemon on this machine")
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("destroy", help="stop coordinator + daemon")
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_destroy)
+
+    p = sub.add_parser("start", help="start a dataflow")
+    p.add_argument("dataflow")
+    p.add_argument("--name", default=None)
+    p.add_argument("--attach", action="store_true", help="wait for completion")
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop a running dataflow")
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument("--grace-duration", type=float, default=None)
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("list", help="list running dataflows")
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("logs", help="print a node's logs")
+    p.add_argument("node")
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("coordinator", help="run the control-plane coordinator")
+    p.add_argument("--port", type=int, default=DORA_COORDINATOR_PORT_DEFAULT)
+    p.add_argument(
+        "--control-port", type=int, default=DORA_COORDINATOR_PORT_CONTROL_DEFAULT
+    )
+    p.add_argument("--quiet", action="store_true")
+    p.set_defaults(fn=cmd_coordinator)
+
+    p = sub.add_parser("daemon", help="run the data-plane daemon")
+    p.add_argument(
+        "--coordinator-addr",
+        default=f"127.0.0.1:{DORA_COORDINATOR_PORT_DEFAULT}",
+        help="coordinator daemon-register address",
+    )
+    p.add_argument("--machine-id", default="")
+    p.add_argument("--run-dataflow", default=None, metavar="DATAFLOW_YAML",
+                   help="standalone mode: run one dataflow and exit")
+    p.add_argument("--local-comm", default="tcp", choices=["tcp", "uds", "shmem"])
+    p.set_defaults(fn=cmd_daemon)
+
+    p = sub.add_parser("runtime", help="run the operator runtime (internal)")
+    p.set_defaults(fn=cmd_runtime)
+
+    p = sub.add_parser("new", help="create a node/operator/dataflow template")
+    p.add_argument("kind", choices=["node", "operator", "dataflow"])
+    p.add_argument("name")
+    p.add_argument("--path", default=None)
+    p.set_defaults(fn=cmd_new)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

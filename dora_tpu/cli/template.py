@@ -1,0 +1,83 @@
+"""`dora-tpu new` project templates.
+
+Reference parity: binaries/cli/src/template/ (rust/python/c/c++ node,
+operator, and dataflow scaffolds) — here Python node, JAX operator, and
+dataflow YAML.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+NODE_TEMPLATE = '''"""{name}: a dora-tpu node."""
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    with Node() as node:
+        for event in node:
+            if event["type"] == "INPUT":
+                # process event["value"] (a pyarrow array) ...
+                node.send_output("out", event["value"], event["metadata"])
+            elif event["type"] == "STOP":
+                break
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+OPERATOR_TEMPLATE = '''"""{name}: a TPU-tier (JAX) dora-tpu operator.
+
+Referenced from a dataflow YAML as:
+
+    operator:
+      jax: {name}/operator.py:make_operator
+      inputs: {{x: some-node/out}}
+      outputs: [y]
+"""
+
+import jax.numpy as jnp
+
+from dora_tpu.tpu.api import JaxOperator
+
+
+def make_operator() -> JaxOperator:
+    def step(state, inputs):
+        x = inputs["x"]
+        return state, {{"y": x * 2.0}}
+
+    return JaxOperator(step=step, init_state=())
+'''
+
+DATAFLOW_TEMPLATE = """nodes:
+  - id: source
+    path: module:dora_tpu.nodehub.pyarrow_sender
+    outputs: [data]
+    env: {{DATA: "[1, 2, 3]"}}
+
+  - id: {name}
+    path: {name}.py
+    inputs:
+      in: source/data
+    outputs: [out]
+"""
+
+
+def create(kind: str, name: str, path: Path) -> int:
+    if kind == "node":
+        path.mkdir(parents=True, exist_ok=True)
+        (path / f"{name}.py").write_text(NODE_TEMPLATE.format(name=name))
+        (path / "dataflow.yml").write_text(DATAFLOW_TEMPLATE.format(name=name))
+        print(f"created node project at {path}")
+    elif kind == "operator":
+        path.mkdir(parents=True, exist_ok=True)
+        (path / "operator.py").write_text(OPERATOR_TEMPLATE.format(name=name))
+        print(f"created operator at {path}")
+    else:
+        target = path if path.suffix else path / "dataflow.yml"
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(DATAFLOW_TEMPLATE.format(name="transform"))
+        print(f"created dataflow at {target}")
+    return 0

@@ -1,0 +1,16 @@
+"""The control-plane coordinator (one per cluster).
+
+Reference parity: binaries/coordinator — daemon registry keyed by machine
+id, dataflow lifecycle across machines (spawn partitioning, ReadyOnMachine
+aggregation → AllNodesReady broadcast, finished-machine aggregation →
+archive + deferred CLI replies), stop/reload/logs proxying, heartbeat
+watchdog, per-dataflow log subscribers.
+
+Testability seam kept from the reference (coordinator/src/lib.rs:42-46):
+`Coordinator.handle_control_request` is directly callable in-process, so
+integration tests drive the full lifecycle without sockets.
+"""
+
+from dora_tpu.coordinator.core import Coordinator
+
+__all__ = ["Coordinator"]

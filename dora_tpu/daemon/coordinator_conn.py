@@ -1,0 +1,198 @@
+"""Attached-mode daemon: connection to the coordinator.
+
+Reference parity: binaries/daemon/src/coordinator.rs (register with 1 s
+retry, event/reply pump) and the coordinator-event handling arm of the
+daemon main loop (daemon/src/lib.rs:364-407). Heartbeat constants match
+the reference: daemon→coordinator every 5 s, bail after 20 s of silence
+(daemon/src/lib.rs:262-268,308-324).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import TYPE_CHECKING
+
+from dora_tpu import PROTOCOL_VERSION
+from dora_tpu.core.descriptor import Descriptor
+from dora_tpu.daemon import inter_daemon
+from dora_tpu.daemon.spawn import log_file_path
+from dora_tpu.message import coordinator as cm
+from dora_tpu.message.serde import decode_timestamped, encode_timestamped
+from dora_tpu.transport.framing import (
+    ConnectionClosed,
+    recv_frame_async,
+    send_frame_async,
+)
+
+if TYPE_CHECKING:
+    from dora_tpu.daemon.core import Daemon
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 5.0
+COORDINATOR_SILENCE_BAIL_S = 20.0
+REGISTER_RETRY_S = 1.0
+
+
+async def run_attached(
+    daemon: "Daemon",
+    coordinator_addr: str,
+    machine_id: str,
+    register_timeout_s: float = 30.0,
+) -> None:
+    """Register with the coordinator and serve its events until destroyed."""
+    daemon.machine_id = machine_id
+    await daemon.start()
+    inter_server, inter_port = await inter_daemon.start_server(daemon)
+    inter_client = inter_daemon.InterDaemonClient(daemon.clock)
+
+    host, _, port = coordinator_addr.rpartition(":")
+    deadline = time.monotonic() + register_timeout_s
+    reader = writer = None
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port))
+            break
+        except ConnectionError:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(REGISTER_RETRY_S)
+
+    await send_frame_async(
+        writer,
+        encode_timestamped(
+            cm.RegisterDaemon(
+                machine_id=machine_id,
+                protocol_version=PROTOCOL_VERSION,
+                listen_port=inter_port,
+            ),
+            daemon.clock,
+        ),
+    )
+    reply = decode_timestamped(await recv_frame_async(reader), daemon.clock).inner
+    if not isinstance(reply, cm.RegisterDaemonReply) or reply.error:
+        raise RuntimeError(f"daemon register failed: {getattr(reply, 'error', reply)}")
+
+    outbox: asyncio.Queue = asyncio.Queue()
+    last_contact = time.monotonic()
+
+    async def sender():
+        while True:
+            msg = await outbox.get()
+            await send_frame_async(writer, encode_timestamped(msg, daemon.clock))
+
+    async def heartbeat():
+        while True:
+            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+            if time.monotonic() - last_contact > COORDINATOR_SILENCE_BAIL_S:
+                logger.error("coordinator silent for >%ss; bailing", COORDINATOR_SILENCE_BAIL_S)
+                writer.close()
+                return
+            outbox.put_nowait(cm.DaemonHeartbeat())
+
+    def notify(kind: str, df, payload) -> None:
+        if kind == "ready":
+            outbox.put_nowait(
+                cm.ReadyOnMachine(dataflow_id=df.id, exited_before_subscribe=payload)
+            )
+        elif kind == "finished":
+            outbox.put_nowait(cm.AllNodesFinished(dataflow_id=df.id, result=payload))
+
+    daemon.coordinator_notify = notify
+    daemon.log_sink = lambda log: outbox.put_nowait(cm.DaemonLog(log=log))
+
+    def send_inter(df, machine, output_id, metadata, payload, closed=None):
+        addr = df.machine_listen_ports.get(machine)
+        if addr is None:
+            logger.warning("no listen addr for machine %r", machine)
+            return
+        if closed is not None:
+            event = cm.InterDaemonInputsClosed(dataflow_id=df.id, inputs=closed)
+        else:
+            event = cm.InterDaemonOutput(
+                dataflow_id=df.id,
+                output_id=output_id,
+                metadata=metadata,
+                data=payload,
+            )
+        asyncio.create_task(inter_client.send(addr, event))
+
+    daemon.inter_daemon_send = send_inter
+
+    tasks = [asyncio.create_task(sender()), asyncio.create_task(heartbeat())]
+    try:
+        while True:
+            frame = await recv_frame_async(reader)
+            last_contact = time.monotonic()
+            event = decode_timestamped(frame, daemon.clock).inner
+            if isinstance(event, cm.Heartbeat):
+                continue
+            if isinstance(event, cm.SpawnDataflowNodes):
+                await _handle_spawn(daemon, outbox, event)
+            elif isinstance(event, cm.AllNodesReady):
+                df = daemon.dataflows.get(event.dataflow_id)
+                if df is None:
+                    continue
+                if event.exited_before_subscribe:
+                    daemon.poison_barrier(df, event.exited_before_subscribe[0])
+                else:
+                    daemon.release_barrier(df)
+            elif isinstance(event, cm.StopDataflow):
+                df = daemon.dataflows.get(event.dataflow_id)
+                if df is not None:
+                    daemon.stop_dataflow(df, event.grace_duration_s)
+            elif isinstance(event, cm.ReloadDataflow):
+                df = daemon.dataflows.get(event.dataflow_id)
+                if df is not None:
+                    daemon.reload_node(df, event.node_id, event.operator_id)
+            elif isinstance(event, cm.LogsRequest):
+                df = daemon.dataflows.get(event.dataflow_id)
+                logs = b""
+                if df is not None:
+                    path = log_file_path(df.working_dir, df.id, event.node_id)
+                    if path.exists():
+                        logs = path.read_bytes()
+                outbox.put_nowait(
+                    cm.LogsReplyFromDaemon(
+                        dataflow_id=event.dataflow_id,
+                        node_id=event.node_id,
+                        logs=logs,
+                    )
+                )
+            elif isinstance(event, cm.DestroyDaemon):
+                return
+            else:
+                logger.warning("unexpected coordinator event %s", type(event).__name__)
+    except (ConnectionClosed, ConnectionError):
+        logger.error("lost coordinator connection")
+    finally:
+        for t in tasks:
+            t.cancel()
+        inter_client.close()
+        inter_server.close()
+        try:
+            writer.close()
+        except Exception:
+            pass
+        await daemon.close()
+
+
+async def _handle_spawn(daemon: "Daemon", outbox, event: cm.SpawnDataflowNodes) -> None:
+    error = None
+    try:
+        descriptor = Descriptor.parse(event.dataflow_descriptor)
+        await daemon.spawn_dataflow(
+            descriptor,
+            dataflow_id=event.dataflow_id,
+            working_dir=event.working_dir,
+            local_nodes=set(event.nodes),
+            machine_listen_ports=event.machine_listen_ports,
+        )
+    except Exception as e:
+        logger.exception("spawn failed")
+        error = str(e)
+    outbox.put_nowait(
+        cm.SpawnDataflowResult(dataflow_id=event.dataflow_id, error=error)
+    )

@@ -197,6 +197,18 @@ class Daemon:
                 except Exception:
                     pass
 
+    async def run(
+        self,
+        coordinator_addr: str,
+        machine_id: str = "",
+        register_timeout_s: float = 30.0,
+    ) -> None:
+        """Attached mode: register with a coordinator and serve its events
+        until destroyed (reference: Daemon::run, daemon/src/lib.rs:93-155)."""
+        from dora_tpu.daemon.coordinator_conn import run_attached
+
+        await run_attached(self, coordinator_addr, machine_id, register_timeout_s)
+
     # ------------------------------------------------------------------
     # dataflow spawn
     # ------------------------------------------------------------------
@@ -320,9 +332,7 @@ class Daemon:
         if node_id in df.pending_nodes:
             df.pending_nodes.discard(node_id)
             if not df.pending_nodes:
-                if self.coordinator_notify is not None and len(
-                    df.descriptor.machines()
-                ) > 1:
+                if self._is_multi_machine(df):
                     # Multi-machine: coordinator aggregates ReadyOnMachine and
                     # broadcasts AllNodesReady (coordinator/src/lib.rs:221-267).
                     self.coordinator_notify("ready", df, [])
@@ -334,6 +344,14 @@ class Daemon:
         df.started.set()
         if error is None:
             self._start_timers(df)
+
+    def release_barrier(self, df: DataflowState) -> None:
+        """Coordinator broadcast AllNodesReady: release the start barrier."""
+        if not df.started.is_set():
+            self._release_barrier(df)
+
+    def _is_multi_machine(self, df: DataflowState) -> bool:
+        return self.coordinator_notify is not None and len(df.descriptor.machines()) > 1
 
     def poison_barrier(self, df: DataflowState, failed_node: str) -> None:
         """A node exited before subscribing: fail the whole start barrier
@@ -609,13 +627,21 @@ class Daemon:
             df.failed_nodes.append(nid)
         df.node_results[nid] = result
 
-        # Barrier poison: node died before subscribing.
+        # Barrier poison: node died before subscribing. In multi-machine
+        # mode the coordinator must learn about it so the other machines'
+        # barriers fail too (reference: pending.rs ReadyOnMachine with
+        # exited_before_subscribe).
         if nid in df.pending_nodes:
             df.pending_nodes.discard(nid)
             if not status.success:
+                if self._is_multi_machine(df):
+                    self.coordinator_notify("ready", df, [nid])
                 self.poison_barrier(df, nid)
             elif not df.pending_nodes:
-                self._release_barrier(df)
+                if self._is_multi_machine(df):
+                    self.coordinator_notify("ready", df, [])
+                else:
+                    self._release_barrier(df)
 
         # Release buffers the dead node still referenced.
         queue = df.queues.get(nid)

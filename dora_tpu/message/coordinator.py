@@ -31,14 +31,14 @@ class Check:
 
 
 @message
-class Reload:
+class ReloadRequest:
     dataflow_id: str
     node_id: str
     operator_id: str | None = None
 
 
 @message
-class Stop:
+class StopRequest:
     dataflow_uuid: str
     grace_duration_s: float | None = None
 
@@ -250,6 +250,8 @@ class DaemonLog:
 
 @message
 class LogsReplyFromDaemon:
+    dataflow_id: str
+    node_id: str
     logs: bytes
 
 

@@ -1,0 +1,101 @@
+"""CLI tests: offline commands plus the full up/start/logs/destroy cycle.
+
+Reference parity: the CLI lifecycle the reference exercises via
+examples (SURVEY.md §4.2) — here driven through the installed entry point
+in subprocesses.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def cli_env(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["DORA_TPU_STATE_DIR"] = str(tmp_path / "state")
+    return env
+
+
+def run_cli(args, tmp_path, timeout=60, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "dora_tpu.cli.main"] + args,
+        env=cli_env(tmp_path),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=str(tmp_path),
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"cli {args} failed ({proc.returncode}):\n{proc.stdout}\n{proc.stderr}"
+        )
+    return proc
+
+
+@pytest.fixture
+def dataflow_yml(tmp_path):
+    spec = {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[1, 2]", "COUNT": "2"},
+            },
+            {
+                "id": "receiver",
+                "path": "module:dora_tpu.nodehub.pyarrow_assert",
+                "inputs": {"in": "sender/data"},
+                "env": {"DATA": "[1, 2]", "MIN_COUNT": "2"},
+            },
+        ]
+    }
+    path = tmp_path / "dataflow.yml"
+    path.write_text(yaml.safe_dump(spec))
+    return path
+
+
+def test_check_and_graph(tmp_path, dataflow_yml):
+    out = run_cli(["check", str(dataflow_yml)], tmp_path)
+    assert "OK" in out.stdout
+    out = run_cli(["graph", str(dataflow_yml), "--mermaid"], tmp_path)
+    assert "flowchart" in out.stdout
+    assert "sender" in out.stdout
+
+
+def test_new_templates(tmp_path):
+    run_cli(["new", "node", "mynode", "--path", str(tmp_path / "proj")], tmp_path)
+    assert (tmp_path / "proj" / "mynode.py").exists()
+    assert (tmp_path / "proj" / "dataflow.yml").exists()
+
+
+def test_standalone_daemon_run(tmp_path, dataflow_yml):
+    out = run_cli(
+        ["daemon", "--run-dataflow", str(dataflow_yml)], tmp_path, timeout=90
+    )
+    assert "finished successfully" in out.stdout
+
+
+def test_up_start_logs_destroy(tmp_path, dataflow_yml):
+    try:
+        run_cli(["up"], tmp_path, timeout=30)
+        start = run_cli(
+            ["start", str(dataflow_yml), "--name", "cli-test", "--attach"],
+            tmp_path,
+            timeout=90,
+        )
+        assert "finished successfully" in start.stdout
+        uuid = start.stdout.splitlines()[0].strip()
+        logs = run_cli(["logs", "receiver", "--uuid", uuid], tmp_path)
+        assert "asserted 2 inputs OK" in logs.stdout
+    finally:
+        run_cli(["destroy"], tmp_path, check=False)

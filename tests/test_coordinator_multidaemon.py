@@ -1,0 +1,158 @@
+"""Coordinator + two daemons in one process: the multi-machine lifecycle.
+
+Reference parity: examples/multiple-daemons/run.rs:29-115 — boots a
+coordinator and two daemons ("A"/"B") on localhost, starts a dataflow with
+nodes pinned to both machines, asserts the control API lifecycle, and
+destroys the cluster. This exercises the cluster-wide start barrier,
+ReadyOnMachine aggregation, inter-daemon output forwarding, and the
+finished-machine aggregation path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+import yaml
+
+from dora_tpu.coordinator import Coordinator
+from dora_tpu.daemon.core import Daemon
+from dora_tpu.message import coordinator as cm
+
+
+def two_machine_spec() -> dict:
+    return {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[5, 6, 7]", "COUNT": "3"},
+                "deploy": {"machine": "A"},
+            },
+            {
+                "id": "receiver",
+                "path": "module:dora_tpu.nodehub.pyarrow_assert",
+                "inputs": {"in": "sender/data"},
+                "env": {"DATA": "[5, 6, 7]", "MIN_COUNT": "3"},
+                "deploy": {"machine": "B"},
+            },
+        ]
+    }
+
+
+async def _wait_machines(coord: Coordinator, expected: set[str], timeout: float = 10):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await coord.handle_control_request(cm.ConnectedMachines())
+        if set(reply.machines) >= expected:
+            return
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"machines {expected} never registered: {reply}")
+        await asyncio.sleep(0.05)
+
+
+async def _wait_finished(coord: Coordinator, uuid: str, timeout: float = 60):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await coord.handle_control_request(cm.Check(dataflow_uuid=uuid))
+        if isinstance(reply, cm.DataflowStopped):
+            return reply.result
+        if isinstance(reply, cm.Error):
+            raise AssertionError(reply.message)
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError("dataflow never finished")
+        await asyncio.sleep(0.1)
+
+
+def test_two_daemons_full_lifecycle(tmp_path):
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        addr = f"127.0.0.1:{coord.daemon_port}"
+        daemon_a, daemon_b = Daemon(), Daemon()
+        tasks = [
+            asyncio.create_task(daemon_a.run(addr, "A")),
+            asyncio.create_task(daemon_b.run(addr, "B")),
+        ]
+        try:
+            await _wait_machines(coord, {"A", "B"})
+
+            reply = await coord.handle_control_request(
+                cm.DaemonConnected()
+            )
+            assert reply.connected
+
+            start = await coord.handle_control_request(
+                cm.Start(
+                    dataflow=two_machine_spec(),
+                    name="multi",
+                    local_working_dir=str(tmp_path),
+                )
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+
+            listed = await coord.handle_control_request(cm.ListDataflows())
+            assert [e.name for e in listed.dataflows] == ["multi"]
+
+            result = await _wait_finished(coord, start.uuid)
+            assert result.is_ok(), result.errors()
+
+            # Logs are retrievable cross-machine after the run.
+            logs = await coord.handle_control_request(
+                cm.Logs(uuid=start.uuid, name=None, node="receiver")
+            )
+            assert b"asserted 3 inputs OK" in logs.logs
+
+            destroy = await coord.handle_control_request(cm.Destroy())
+            assert isinstance(destroy, cm.DestroyOk)
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout=10)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await coord.close()
+
+    asyncio.run(main())
+
+
+def test_stop_running_dataflow(tmp_path):
+    """A long-running dataflow (timer-driven) stops cleanly on request."""
+    spec = {
+        "nodes": [
+            {
+                "id": "ticker",
+                "path": "module:dora_tpu.nodehub.echo",
+                "inputs": {"in": "dora/timer/millis/100"},
+                "outputs": ["echo"],
+                "deploy": {"machine": "A"},
+            }
+        ]
+    }
+
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        addr = f"127.0.0.1:{coord.daemon_port}"
+        daemon = Daemon()
+        task = asyncio.create_task(daemon.run(addr, "A"))
+        try:
+            await _wait_machines(coord, {"A"})
+            start = await coord.handle_control_request(
+                cm.Start(dataflow=spec, name=None, local_working_dir=str(tmp_path))
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+            await asyncio.sleep(0.5)  # let it tick a few times
+            stopped = await asyncio.wait_for(
+                coord.handle_control_request(
+                    cm.StopRequest(dataflow_uuid=start.uuid, grace_duration_s=5)
+                ),
+                timeout=30,
+            )
+            assert isinstance(stopped, cm.DataflowStopped), stopped
+            assert stopped.result.is_ok(), stopped.result.errors()
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            task.cancel()
+            await coord.close()
+
+    asyncio.run(main())
