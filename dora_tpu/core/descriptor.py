@@ -195,6 +195,31 @@ class ResolvedNode:
             DataId(f"{op.id}/{o}") for op in self.kind.operators for o in op.outputs
         )
 
+    def fused_internal_inputs(self) -> frozenset[DataId]:
+        """Inputs satisfied *inside* the node by the fused jax subgraph
+        (both endpoints are jax operators of this node). These edges are SSA
+        values in one XLA computation — the daemon must not build routing
+        queues for them, and the source output is never published
+        (dora_tpu.tpu.fuse lowers them)."""
+        if not isinstance(self.kind, RuntimeNode):
+            return frozenset()
+        jax_ops = {
+            str(op.id)
+            for op in self.kind.operators
+            if isinstance(op.source, JaxSource)
+        }
+        internal = set()
+        for op in self.kind.operators:
+            if str(op.id) not in jax_ops:
+                continue
+            for input_id, inp in op.inputs.items():
+                m = inp.mapping
+                if isinstance(m, UserMapping) and str(m.source) == str(self.id):
+                    src_op = str(m.output).partition("/")[0]
+                    if src_op in jax_ops:
+                        internal.add(DataId(f"{op.id}/{input_id}"))
+        return frozenset(internal)
+
     @property
     def send_stdout_as(self) -> str | None:
         if isinstance(self.kind, CustomNode):

@@ -246,7 +246,12 @@ class Daemon:
                 df.open_outputs.add(OutputId(node.id, output))
         for node in descriptor.nodes:
             nid = str(node.id)
+            fused_internal = node.fused_internal_inputs()
             for input_id, inp in node.inputs.items():
+                if input_id in fused_internal:
+                    # Edge between two fused jax operators: an SSA value
+                    # inside the node's XLA computation, not a routed input.
+                    continue
                 target = InputId(node.id, input_id)
                 if isinstance(inp.mapping, TimerMapping):
                     df.timers.setdefault(inp.mapping.interval_ns, set()).add(target)

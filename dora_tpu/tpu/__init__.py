@@ -1,0 +1,18 @@
+"""The TPU execution tier — the part the reference does not have.
+
+Operators declared with ``jax:`` sources are pure functions
+``(state, inputs) -> (state, outputs)`` over JAX arrays. All jax operators
+hosted in one runtime node are **fused into a single jit-compiled XLA
+computation per tick**: edges between them become SSA values that never
+leave device HBM (no Arrow materialization, no IPC), and operator state is
+donated back to itself across ticks. Only edges crossing the node boundary
+materialize to Arrow messages.
+
+This is the TPU-first answer to the reference's operator runtime
+(binaries/runtime), which hosts exactly one operator per process and moves
+every edge through the daemon (SURVEY.md §2.2 dora-runtime row).
+"""
+
+from dora_tpu.tpu.api import DoraStatus, JaxOperator
+
+__all__ = ["JaxOperator", "DoraStatus"]

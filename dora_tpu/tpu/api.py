@@ -1,0 +1,81 @@
+"""Operator APIs: the JAX (TPU-tier) operator ABI and the Python operator
+status codes.
+
+Reference parity: apis/rust/operator (DoraOperator::on_event + DoraStatus,
+src/lib.rs:41-69) and the Python ``Operator.on_event(event, send_output)``
+convention (binaries/runtime/src/operator/python.rs:93-107). The JAX
+operator is this framework's TPU-native addition: a pure traced function
+instead of a callback, so adjacent operators fuse into one XLA program.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class DoraStatus(enum.IntEnum):
+    """Return value of a Python operator's on_event
+    (reference: DoraStatus{Continue,Stop,StopAll})."""
+
+    CONTINUE = 0
+    STOP = 1
+    STOP_ALL = 2
+
+
+@dataclass
+class JaxOperator:
+    """A TPU-tier operator: a pure function over JAX pytrees.
+
+    ``step(state, inputs) -> (new_state, outputs)`` where ``inputs`` /
+    ``outputs`` are dicts keyed by the operator's declared input/output
+    names and values are JAX arrays (or pytrees). The function must be
+    traceable: no side effects, no data-dependent Python control flow.
+
+    The runtime jits the fused graph with the state donated, so ``state``
+    lives in device HBM across ticks; weights belong in ``init_state``.
+
+    ``input_shapes`` optionally pins {input: (shape, dtype)} so the fused
+    computation can warm-compile before the first tick; unset inputs
+    compile on first arrival.
+
+    ``sharding`` optionally names a mesh-axis layout for the operator's
+    state (applied via jax.sharding when the runtime runs on a mesh; see
+    dora_tpu.parallel).
+    """
+
+    step: Callable[[Any, dict[str, Any]], tuple[Any, dict[str, Any]]]
+    init_state: Any = ()
+    input_shapes: dict[str, tuple] = field(default_factory=dict)
+    sharding: Any = None
+
+
+def load_jax_operator(source: str, working_dir=None) -> JaxOperator:
+    """Resolve a ``jax:`` operator source — ``module.path:factory`` or
+    ``file.py:factory`` (factory defaults to ``make_operator``)."""
+    import importlib
+    import importlib.util
+    from pathlib import Path
+
+    mod_path, sep, factory_name = source.partition(":")
+    factory_name = factory_name if sep else "make_operator"
+    if mod_path.endswith(".py"):
+        path = Path(mod_path)
+        if working_dir is not None and not path.is_absolute():
+            path = Path(working_dir) / path
+        spec = importlib.util.spec_from_file_location(
+            f"dora_tpu_op_{path.stem}", path
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(mod_path)
+    factory = getattr(module, factory_name)
+    operator = factory()
+    if not isinstance(operator, JaxOperator):
+        raise TypeError(
+            f"{source}: factory returned {type(operator).__name__}, "
+            f"expected JaxOperator"
+        )
+    return operator

@@ -1,0 +1,65 @@
+"""Arrow ⇄ JAX device-array bridge.
+
+Reference parity: the Arrow C-FFI / pyarrow boundary of the reference's
+node APIs (SURVEY.md §2.9 "collective/comm backend" row: the TPU-native
+equivalent is Arrow ⇄ DLPack into JAX device buffers).
+
+Tensor convention on the wire: a 1-D Arrow primitive array plus metadata
+parameters ``shape`` (list of ints) and ``dtype``; scalars and 1-D data
+need no metadata. Host-side conversion is zero-copy (Arrow → numpy view);
+the host→HBM transfer happens once per tick at the fused-subgraph ingress.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import pyarrow as pa
+
+SHAPE_KEY = "shape"
+DTYPE_KEY = "dtype"
+
+
+def arrow_to_host(value: pa.Array, metadata: dict | None = None) -> np.ndarray:
+    """Arrow array -> numpy (zero-copy when the type allows), reshaped per
+    the ``shape`` metadata."""
+    try:
+        arr = value.to_numpy(zero_copy_only=True)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        arr = value.to_numpy(zero_copy_only=False)
+    if metadata:
+        shape = metadata.get(SHAPE_KEY)
+        if shape is not None:
+            arr = arr.reshape([int(s) for s in shape])
+        dtype = metadata.get(DTYPE_KEY)
+        if dtype is not None and str(arr.dtype) != dtype:
+            arr = arr.astype(dtype)
+    return arr
+
+
+def arrow_to_device(value: pa.Array, metadata: dict | None = None):
+    """Arrow array -> JAX device array (one host→HBM transfer)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(arrow_to_host(value, metadata))
+
+
+def device_to_arrow(arr: Any) -> tuple[pa.Array, dict]:
+    """JAX (or numpy) array -> (1-D Arrow array, tensor metadata).
+
+    The device→host copy happens here — exactly once per externally
+    consumed output per tick.
+    """
+    host = np.asarray(arr)
+    metadata = {SHAPE_KEY: list(host.shape), DTYPE_KEY: str(host.dtype)}
+    if host.dtype == np.dtype("bfloat16"):
+        # Arrow has no bfloat16; widen on the wire, keep dtype metadata so
+        # the receiver restores it.
+        host = host.astype(np.float32)
+    flat = np.ascontiguousarray(host).reshape(-1)
+    return pa.array(flat), metadata
+
+
+def is_tensor_metadata(metadata: dict | None) -> bool:
+    return bool(metadata) and SHAPE_KEY in metadata

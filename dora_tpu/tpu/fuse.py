@@ -1,0 +1,232 @@
+"""Fusion compiler: all jax operators of one runtime node become ONE
+jit-compiled XLA computation per tick.
+
+Graph lowering (SURVEY.md §7 step 5c): intra-node edges between jax
+operators become SSA values inside the traced function — they never
+materialize to Arrow, never cross a process boundary, and stay in device
+HBM. Only inputs arriving from outside the node and outputs consumed
+outside the node touch the Arrow data plane. Operator state is threaded
+through the jit with donation, so it lives in HBM across ticks.
+
+Tick semantics (the async-graph ↔ synchronous-XLA impedance match): timer
+inputs are the tick triggers when present (the reference's vlm example
+pattern — 20 ms camera timer, 100 ms model timer); otherwise every
+external data input triggers. Non-trigger inputs are sampled latest-wins,
+which is the reference's ``queue_size: 1`` idiom.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from dora_tpu.core.config import TimerMapping, UserMapping
+from dora_tpu.core.descriptor import (
+    Descriptor,
+    JaxSource,
+    OperatorDefinition,
+    ResolvedNode,
+    RuntimeNode,
+)
+from dora_tpu.tpu.api import JaxOperator, load_jax_operator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class FusedGraph:
+    """The static structure of one node's fused jax subgraph."""
+
+    node_id: str
+    operators: dict[str, JaxOperator]  # op id -> operator
+    definitions: dict[str, OperatorDefinition]
+    topo: list[str]  # op ids in dataflow order
+    #: (op, input) -> (src op, src output): intra-node SSA edges
+    intra_edges: dict[tuple[str, str], tuple[str, str]]
+    #: event ids ("<op>/<input>") carrying data from outside the node
+    external_inputs: set[str]
+    #: event ids fed by daemon timers (trigger, no payload)
+    timer_inputs: set[str]
+    #: output ids ("<op>/<output>") consumed outside the node
+    external_outputs: set[str]
+
+    @property
+    def trigger_inputs(self) -> set[str]:
+        return self.timer_inputs or self.external_inputs
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls,
+        node: ResolvedNode,
+        descriptor: Descriptor | None = None,
+        working_dir=None,
+    ) -> "FusedGraph":
+        assert isinstance(node.kind, RuntimeNode)
+        jax_defs = {
+            str(op.id): op
+            for op in node.kind.operators
+            if isinstance(op.source, JaxSource)
+        }
+        operators = {
+            op_id: load_jax_operator(op.source.source, working_dir)
+            for op_id, op in jax_defs.items()
+        }
+
+        intra: dict[tuple[str, str], tuple[str, str]] = {}
+        external_inputs: set[str] = set()
+        timer_inputs: set[str] = set()
+        for op_id, op in jax_defs.items():
+            for input_id, inp in op.inputs.items():
+                if isinstance(inp.mapping, TimerMapping):
+                    timer_inputs.add(f"{op_id}/{input_id}")
+                    continue
+                mapping: UserMapping = inp.mapping
+                if str(mapping.source) == str(node.id):
+                    # Sibling edge "<self>/<src_op>/<src_out>".
+                    src_op, _, src_out = str(mapping.output).partition("/")
+                    if src_op in jax_defs:
+                        intra[(op_id, str(input_id))] = (src_op, src_out)
+                        continue
+                external_inputs.add(f"{op_id}/{input_id}")
+
+        topo = _topo_sort(list(jax_defs), intra)
+
+        # Outputs with consumers outside this fused subgraph (other nodes, or
+        # python operators of the same node). Without a full descriptor we
+        # conservatively export everything.
+        external_outputs: set[str] = set()
+        if descriptor is not None:
+            for consumer in descriptor.nodes:
+                for input_id, inp in consumer.inputs.items():
+                    if isinstance(inp.mapping, TimerMapping):
+                        continue
+                    m: UserMapping = inp.mapping
+                    if str(m.source) != str(node.id):
+                        continue
+                    out = str(m.output)  # "<op>/<output>"
+                    src_op = out.partition("/")[0]
+                    if src_op not in jax_defs:
+                        continue
+                    consumes_internally = (
+                        str(consumer.id) == str(node.id)
+                        and (str(input_id).partition("/")[0]) in jax_defs
+                        and (
+                            str(input_id).partition("/")[0],
+                            str(input_id).partition("/")[2],
+                        )
+                        in intra
+                    )
+                    if not consumes_internally:
+                        external_outputs.add(out)
+        else:
+            for op_id, op in jax_defs.items():
+                external_outputs |= {f"{op_id}/{o}" for o in op.outputs}
+
+        return cls(
+            node_id=str(node.id),
+            operators=operators,
+            definitions=jax_defs,
+            topo=topo,
+            intra_edges=intra,
+            external_inputs=external_inputs,
+            timer_inputs=timer_inputs,
+            external_outputs=external_outputs,
+        )
+
+    # -- the traced function ------------------------------------------------
+
+    def step_fn(self, states: dict, ext_inputs: dict) -> tuple[dict, dict]:
+        """The pure fused step: runs every operator in topo order with
+        sibling edges as local SSA values. jit-compiled by the executor;
+        unused outputs are dead-code-eliminated by XLA."""
+        produced: dict[str, dict[str, Any]] = {}
+        new_states: dict[str, Any] = {}
+        for op_id in self.topo:
+            operator = self.operators[op_id]
+            definition = self.definitions[op_id]
+            inputs: dict[str, Any] = {}
+            for input_id in definition.inputs:
+                iid = str(input_id)
+                edge = self.intra_edges.get((op_id, iid))
+                if edge is not None:
+                    inputs[iid] = produced[edge[0]][edge[1]]
+                else:
+                    event_id = f"{op_id}/{iid}"
+                    if event_id in ext_inputs:
+                        inputs[iid] = ext_inputs[event_id]
+            new_states[op_id], outputs = operator.step(states[op_id], inputs)
+            produced[op_id] = outputs
+        external = {
+            out_id: produced[out_id.partition("/")[0]][out_id.partition("/")[2]]
+            for out_id in sorted(self.external_outputs)
+            if out_id.partition("/")[2] in produced.get(out_id.partition("/")[0], {})
+        }
+        return new_states, external
+
+
+def _topo_sort(op_ids: list[str], intra: dict[tuple[str, str], tuple[str, str]]) -> list[str]:
+    deps: dict[str, set[str]] = {op: set() for op in op_ids}
+    for (dst, _), (src, _) in intra.items():
+        deps[dst].add(src)
+    order: list[str] = []
+    ready = sorted(op for op, d in deps.items() if not d)
+    while ready:
+        op = ready.pop(0)
+        order.append(op)
+        for other, d in deps.items():
+            if op in d:
+                d.discard(op)
+                if not d and other not in order and other not in ready:
+                    ready.append(other)
+                    ready.sort()
+    if len(order) != len(op_ids):
+        cyclic = sorted(set(op_ids) - set(order))
+        raise ValueError(f"cycle among fused jax operators: {cyclic}")
+    return order
+
+
+class FusedExecutor:
+    """Runtime driver of one fused graph: latest-wins input sampling, tick
+    triggering, jit with state donation."""
+
+    def __init__(self, graph: FusedGraph):
+        import jax
+
+        self.graph = graph
+        self.states = {
+            op_id: jax.device_put(op.init_state)
+            for op_id, op in graph.operators.items()
+        }
+        #: latest device value per external data input (latest-wins sampling)
+        self.latest: dict[str, Any] = {}
+        # Donate state so it is updated in place in HBM; on CPU donation is
+        # unimplemented and only produces warnings, so skip it there.
+        donate = (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+        self._jit = jax.jit(graph.step_fn, donate_argnums=donate)
+        self._required = graph.external_inputs - graph.timer_inputs
+
+    def on_event(self, event_id: str, value, metadata: dict | None):
+        """Feed one arriving event; returns {output_id: (arrow, metadata)}
+        when the event triggered a tick, else None."""
+        from dora_tpu.tpu.bridge import arrow_to_device
+
+        if event_id in self._required and value is not None:
+            self.latest[event_id] = arrow_to_device(value, metadata)
+        elif event_id not in self.graph.trigger_inputs:
+            return None
+        if event_id not in self.graph.trigger_inputs:
+            return None
+        if not all(k in self.latest for k in self._required):
+            return None  # warm-up: not every input has produced yet
+        return self.tick()
+
+    def tick(self):
+        from dora_tpu.tpu.bridge import device_to_arrow
+
+        self.states, outputs = self._jit(self.states, dict(self.latest))
+        return {
+            out_id: device_to_arrow(value) for out_id, value in outputs.items()
+        }

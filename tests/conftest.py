@@ -7,7 +7,10 @@ exercised without TPU hardware. Must run before any jax import.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points JAX at real TPU
+# hardware (e.g. JAX_PLATFORMS=axon via a tunnel): tests must never touch
+# the chip, and spawned node subprocesses inherit this via os.environ.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

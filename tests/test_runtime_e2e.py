@@ -1,0 +1,137 @@
+"""End-to-end operator-runtime tests: daemon-spawned runtime nodes hosting
+fused jax operators and Python operators.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import yaml
+
+from dora_tpu.daemon import run_dataflow
+
+
+def write(tmp_path, name, text):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(text))
+    return path
+
+
+def test_fused_jax_pipeline_e2e(tmp_path):
+    """sender -> [double ∘ plus1 fused in one runtime node] -> checker."""
+    write(tmp_path, "ops.py", """
+        from dora_tpu.tpu.api import JaxOperator
+
+        def make_double():
+            return JaxOperator(step=lambda s, i: (s, {"y": i["x"] * 2.0}))
+
+        def make_plus1():
+            return JaxOperator(step=lambda s, i: (s, {"y": i["x"] + 1.0}))
+    """)
+    write(tmp_path, "checker.py", """
+        import numpy as np
+
+        from dora_tpu.node import Node
+        from dora_tpu.tpu.bridge import arrow_to_host
+
+        node = Node()
+        got = []
+        for event in node:
+            if event["type"] == "INPUT":
+                got.append(arrow_to_host(event["value"], event["metadata"]))
+        node.close()
+        assert len(got) == 2, got
+        for arr in got:
+            np.testing.assert_allclose(arr, [3.0, 5.0])
+            assert arr.dtype == np.float32, arr.dtype
+        print("fused pipeline OK")
+    """)
+    spec = {
+        "nodes": [
+            {
+                "id": "source",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[1.0, 2.0]", "COUNT": "2"},
+            },
+            {
+                "id": "pipeline",
+                "operators": [
+                    {
+                        "id": "double",
+                        "jax": "ops.py:make_double",
+                        "inputs": {"x": "source/data"},
+                        "outputs": ["y"],
+                    },
+                    {
+                        "id": "plus1",
+                        "jax": "ops.py:make_plus1",
+                        "inputs": {"x": "pipeline/double/y"},
+                        "outputs": ["y"],
+                    },
+                ],
+            },
+            {
+                "id": "checker",
+                "path": "checker.py",
+                "inputs": {"in": "pipeline/plus1/y"},
+            },
+        ]
+    }
+    path = tmp_path / "dataflow.yml"
+    path.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(path, timeout_s=120)
+    assert result.is_ok(), result.errors()
+    log = (tmp_path / "out" / result.uuid / "log_checker.txt").read_text()
+    assert "fused pipeline OK" in log
+
+
+def test_python_operator_e2e(tmp_path):
+    """A Python operator (single-operator shorthand) transforms events
+    (reference: python-operator-dataflow example)."""
+    write(tmp_path, "op.py", """
+        import pyarrow as pa
+
+        from dora_tpu.tpu.api import DoraStatus
+
+        class Operator:
+            def __init__(self):
+                self.count = 0
+
+            def on_event(self, event, send_output):
+                if event["type"] == "INPUT":
+                    self.count += 1
+                    doubled = pa.array(
+                        [v.as_py() * 2 for v in event["value"]]
+                    )
+                    send_output("out", doubled, event["metadata"])
+                return DoraStatus.CONTINUE
+    """)
+    spec = {
+        "nodes": [
+            {
+                "id": "source",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[2, 4]", "COUNT": "3"},
+            },
+            {
+                "id": "transform",
+                "operator": {
+                    "python": "op.py",
+                    "inputs": {"in": "source/data"},
+                    "outputs": ["out"],
+                },
+            },
+            {
+                "id": "receiver",
+                "path": "module:dora_tpu.nodehub.pyarrow_assert",
+                "inputs": {"in": "transform/op/out"},
+                "env": {"DATA": "[4, 8]", "MIN_COUNT": "3"},
+            },
+        ]
+    }
+    path = tmp_path / "dataflow.yml"
+    path.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(path, timeout_s=120)
+    assert result.is_ok(), result.errors()
