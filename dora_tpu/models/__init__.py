@@ -1,0 +1,17 @@
+"""TPU-native model families (the node-hub "model zoo" re-designed for
+MXU/HBM, SURVEY.md §2.4):
+
+  * ``vlm``       — Qwen2-VL-class vision-language model (flagship):
+                    ViT encoder + causal LM with KV cache, greedy
+                    generation, dp/tp/sp-sharded training step.
+  * ``detection`` — YOLO-class single-shot detector (anchor-free conv
+                    net, bbox decoding on device).
+  * ``asr``       — Distil-Whisper-class speech recognition
+                    (log-mel frontend + encoder-decoder transformer).
+  * ``vad``       — Silero-class voice activity detection.
+
+All models are pure-JAX (dict-pytree parameters, functional transforms):
+bfloat16 matmuls for the MXU, static shapes, `lax.scan` decode loops, and
+sharding via named mesh axes (dora_tpu.parallel). Weights are initialized
+randomly; checkpoints load via orbax (dora_tpu.models.checkpoint).
+"""

@@ -1,0 +1,193 @@
+"""Shared pure-JAX transformer building blocks.
+
+Design: parameters are nested dicts of arrays (full sharding control, no
+framework indirection); compute in bfloat16 on accelerators (MXU-native),
+accumulate norms/softmax in float32; tensor-parallel layouts follow the
+Megatron pattern (qkv/up column-split, out/down row-split) so each block
+needs exactly one psum pair, inserted by XLA from sharding annotations.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_dtype():
+    return jnp.bfloat16 if jax.default_backend() in ("tpu", "gpu") else jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+
+def dense_init(key, d_in: int, d_out: int, dtype=jnp.float32):
+    scale = 1.0 / math.sqrt(d_in)
+    return jax.random.uniform(key, (d_in, d_out), dtype, -scale, scale)
+
+
+def embed_init(key, vocab: int, dim: int, dtype=jnp.float32):
+    return jax.random.normal(key, (vocab, dim), dtype) * 0.02
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight).astype(dtype)
+
+
+def rope_table(max_len: int, head_dim: int, base: float = 10000.0):
+    """(cos, sin) tables [max_len, head_dim/2] in float32."""
+    inv_freq = 1.0 / base ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    )
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin, positions):
+    """x: [B, H, T, D]; positions: [B, T] absolute token positions."""
+    cos = cos[positions][:, None, :, :]  # [B,1,T,D/2]
+    sin = sin[positions][:, None, :, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def attention(q, k, v, mask=None):
+    """Dense attention, [B,H,T,D]; softmax in float32."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def causal_mask(tq: int, tk: int, offset: int = 0):
+    """[1,1,tq,tk] boolean mask; offset = number of cached tokens before q."""
+    qi = jnp.arange(tq)[:, None] + offset
+    ki = jnp.arange(tk)[None, :]
+    return (qi >= ki)[None, None, :, :]
+
+
+# ---------------------------------------------------------------------------
+# transformer block (pre-norm, SwiGLU)
+# ---------------------------------------------------------------------------
+
+
+def init_block(key, dim: int, n_heads: int, ffn_dim: int, n_kv_heads: int | None = None):
+    n_kv_heads = n_kv_heads or n_heads
+    head_dim = dim // n_heads
+    keys = jax.random.split(key, 7)
+    return {
+        "attn_norm": jnp.ones((dim,), jnp.float32),
+        "wq": dense_init(keys[0], dim, n_heads * head_dim),
+        "wk": dense_init(keys[1], dim, n_kv_heads * head_dim),
+        "wv": dense_init(keys[2], dim, n_kv_heads * head_dim),
+        "wo": dense_init(keys[3], n_heads * head_dim, dim),
+        "ffn_norm": jnp.ones((dim,), jnp.float32),
+        "w_gate": dense_init(keys[4], dim, ffn_dim),
+        "w_up": dense_init(keys[5], dim, ffn_dim),
+        "w_down": dense_init(keys[6], ffn_dim, dim),
+    }
+
+
+def block_forward(
+    params: dict,
+    x,
+    n_heads: int,
+    *,
+    n_kv_heads: int | None = None,
+    rope: tuple | None = None,
+    positions=None,
+    mask=None,
+    cache: dict | None = None,
+    cache_index=None,
+    mesh=None,
+    ring_axis: str | None = None,
+):
+    """One pre-norm block. Returns (y, new_cache).
+
+    With ``cache`` (decode): k/v are written at ``cache_index`` and attention
+    runs against the full cache. With ``ring_axis``: attention runs as ring
+    attention over that mesh axis (training/prefill long-context path).
+    """
+    b, t, dim = x.shape
+    n_kv = n_kv_heads or n_heads
+    head_dim = dim // n_heads
+    dtype = x.dtype
+
+    h = rms_norm(x, params["attn_norm"])
+    q = (h @ params["wq"].astype(dtype)).reshape(b, t, n_heads, head_dim)
+    k = (h @ params["wk"].astype(dtype)).reshape(b, t, n_kv, head_dim)
+    v = (h @ params["wv"].astype(dtype)).reshape(b, t, n_kv, head_dim)
+    q, k, v = (z.transpose(0, 2, 1, 3) for z in (q, k, v))  # [B,H,T,D]
+
+    if rope is not None:
+        cos, sin = rope
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+    new_cache = None
+    if cache is not None:
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, 0, cache_index, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, 0, cache_index, 0)
+        )
+        new_cache = {"k": k, "v": v}
+
+    if n_kv != n_heads:  # grouped-query: repeat kv heads
+        rep = n_heads // n_kv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    if ring_axis is not None and mesh is not None:
+        from dora_tpu.parallel.ring import ring_attention
+
+        out = ring_attention(q, k, v, mesh, causal=mask is not None, axis=ring_axis)
+    else:
+        out = attention(q, k.astype(dtype), v.astype(dtype), mask)
+
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, n_heads * head_dim)
+    x = x + out @ params["wo"].astype(dtype)
+
+    h = rms_norm(x, params["ffn_norm"])
+    gate = jax.nn.silu(h @ params["w_gate"].astype(dtype))
+    up = h @ params["w_up"].astype(dtype)
+    x = x + (gate * up) @ params["w_down"].astype(dtype)
+    return x, new_cache
+
+
+#: Tensor-parallel sharding rules for block parameters (Megatron layout):
+#: column-parallel for q/k/v/gate/up, row-parallel for o/down.
+def tp_rules():
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        ("wq", P(None, "tp")),
+        ("wk", P(None, "tp")),
+        ("wv", P(None, "tp")),
+        ("wo", P("tp", None)),
+        ("w_gate", P(None, "tp")),
+        ("w_up", P(None, "tp")),
+        ("w_down", P("tp", None)),
+        ("embed", P("tp", None)),
+        ("lm_head", P(None, "tp")),
+        ("patch_proj", P(None, "tp")),
+    ]

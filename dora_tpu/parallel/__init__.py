@@ -1,0 +1,34 @@
+"""Parallelism: device meshes, sharding helpers, ring attention.
+
+The reference has no ML parallelism (SURVEY.md §2.9); its scale axes are
+graph fan-out and multi-machine placement. The TPU build adds the tensor
+tier: models shard over a `jax.sharding.Mesh` with named axes
+
+  * ``dp`` — data parallel (batch),
+  * ``tp`` — tensor parallel (heads / hidden, rides ICI),
+  * ``sp`` — sequence parallel (ring attention for long context).
+
+XLA inserts the collectives (psum/all-gather/reduce-scatter/ppermute)
+from sharding annotations; nothing here hand-schedules communication
+except the ring-attention ppermute loop, which is explicit by design.
+"""
+
+from dora_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_SP,
+    AXIS_TP,
+    make_mesh,
+    shard,
+    shard_params,
+)
+from dora_tpu.parallel.ring import ring_attention
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_TP",
+    "AXIS_SP",
+    "make_mesh",
+    "shard",
+    "shard_params",
+    "ring_attention",
+]

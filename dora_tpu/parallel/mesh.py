@@ -1,0 +1,66 @@
+"""Device-mesh construction and sharding helpers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+AXIS_DP = "dp"  # data (batch)
+AXIS_TP = "tp"  # tensor (heads / ffn hidden)
+AXIS_SP = "sp"  # sequence (ring attention)
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, devices=None):
+    """Build a Mesh with named axes (dp, tp, sp). Axis sizes must multiply
+    to the device count; pass dp=-1 to absorb the remainder into data
+    parallelism."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp == -1:
+        if n % (tp * sp):
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(f"dp*tp*sp={dp * tp * sp} != {n} devices")
+    grid = np.array(devices).reshape(dp, tp, sp)
+    return Mesh(grid, (AXIS_DP, AXIS_TP, AXIS_SP))
+
+
+def named(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard(x, mesh, *spec):
+    """Constrain (inside jit) or place (outside jit) ``x`` on the mesh."""
+    import jax
+
+    sharding = named(mesh, *spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
+def shard_params(params: Any, mesh, rules) -> Any:
+    """Place a parameter pytree on the mesh.
+
+    ``rules`` maps a path-suffix predicate to a PartitionSpec: a list of
+    ``(match, spec)`` where ``match`` is a substring of the '/'-joined
+    parameter path. First match wins; default is full replication.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def place(path, leaf):
+        path_str = "/".join(str(getattr(k, "key", k)) for k in path)
+        for match, spec in rules:
+            if match in path_str:
+                return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return jax.device_put(leaf, NamedSharding(mesh, PartitionSpec()))
+
+    return jax.tree_util.tree_map_with_path(place, params)

@@ -1,0 +1,118 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context sequence parallelism for the TPU tier (SURVEY.md §5.7): the
+sequence axis is sharded over the mesh's ``sp`` axis; each device holds a
+Q/K/V block and K/V blocks rotate around the ring via ``ppermute`` (ICI
+neighbor exchange) while a numerically-stable log-sum-exp accumulator
+merges partial attention — compute overlaps communication and no device
+ever materializes the full sequence. (Liu et al., "Ring Attention with
+Blockwise Transformers"; see PAPERS.md.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dora_tpu.parallel.mesh import AXIS_SP
+
+
+def _block_attend(q, k, v, mask=None):
+    """One Q-block × K/V-block partial attention.
+
+    Returns (unnormalized out, running max m, running denom l) for
+    log-sum-exp merging. Shapes: q [B,H,Tq,D], k/v [B,H,Tk,D].
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    # Fully-masked rows: max is -inf; clamp so exp() stays finite.
+    m = jnp.maximum(m, jnp.finfo(scores.dtype).min / 2)
+    p = jnp.exp(scores - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return out, m, l
+
+
+def _merge(acc, new):
+    """Merge two partial attention accumulators with stable LSE."""
+    out_a, m_a, l_a = acc
+    out_b, m_b, l_b = new
+    m = jnp.maximum(m_a, m_b)
+    a_scale = jnp.exp(m_a - m)
+    b_scale = jnp.exp(m_b - m)
+    return (out_a * a_scale + out_b * b_scale, m, l_a * a_scale + l_b * b_scale)
+
+
+def ring_attention(q, k, v, mesh, causal: bool = True, axis: str = AXIS_SP):
+    """Exact (optionally causal) attention with q/k/v sharded on ``axis``
+    along the sequence dimension. Shapes: [batch, heads, seq, head_dim].
+
+    Causality across blocks uses global positions: block ``i`` attends to
+    block ``j`` fully when j < i, diagonally when j == i, not at all when
+    j > i.
+    """
+    sp = mesh.shape[axis]
+    if sp == 1:
+        out, m, l = _block_attend(q, k, v, _causal_mask(q.shape[2], k.shape[2], 0, 0) if causal else None)
+        return out / l
+
+    def local(q, k, v):
+        idx = jax.lax.axis_index(axis)
+        block_len = q.shape[2]
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, _):
+            acc, kv, src = carry
+            k_blk, v_blk = kv
+            if causal:
+                mask = _block_causal_mask(block_len, idx, src, sp)
+            else:
+                mask = None
+            partial = _block_attend(q, k_blk, v_blk, mask)
+            acc = _merge(acc, partial)
+            # Rotate K/V to the next device; src index follows the ring.
+            k_nxt = jax.lax.ppermute(k_blk, axis, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis, perm)
+            src_nxt = (src - 1) % sp
+            return (acc, (k_nxt, v_nxt), src_nxt), None
+
+        # Derive the zero accumulator from q so every component carries q's
+        # device-varying type (a plain jnp.zeros would be "replicated" and
+        # mismatch the scan carry under shard_map's VMA checking).
+        zero = (
+            jnp.zeros_like(q),
+            q[..., :1] * 0 + jnp.finfo(q.dtype).min / 2,
+            q[..., :1] * 0,
+        )
+        (acc, _, _), _ = jax.lax.scan(step, (zero, (k, v), idx), None, length=sp)
+        out, m, l = acc
+        return out / jnp.maximum(l, 1e-20)
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def _causal_mask(tq, tk, q_off, k_off):
+    qi = jnp.arange(tq)[:, None] + q_off
+    ki = jnp.arange(tk)[None, :] + k_off
+    return qi >= ki
+
+
+def _block_causal_mask(block_len, q_block_idx, k_block_idx, sp):
+    """Causal mask between the local Q block and the K block currently held
+    (global block indices)."""
+    q_off = q_block_idx * block_len
+    k_off = k_block_idx * block_len
+    full = _causal_mask(block_len, block_len, q_off, k_off)
+    return full[None, None, :, :]

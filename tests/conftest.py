@@ -8,9 +8,19 @@ import os
 import sys
 
 # Force CPU even when the ambient environment points JAX at real TPU
-# hardware (e.g. JAX_PLATFORMS=axon via a tunnel): tests must never touch
-# the chip, and spawned node subprocesses inherit this via os.environ.
+# hardware (JAX_PLATFORMS=axon via a tunnel): tests must never touch the
+# chip, and spawned node subprocesses inherit this via os.environ. The
+# axon sitecustomize registers its PJRT plugin whenever
+# PALLAS_AXON_POOL_IPS is set (overriding JAX_PLATFORMS), so drop it.
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+try:  # this interpreter already ran sitecustomize — undo its override
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
