@@ -1,0 +1,159 @@
+"""Benchmark entry point (driver-run).
+
+Primary metric — the reference's headline axis (README.md:52 benches
+40 MB random-bytes messages vs ROS2): p50 end-to-end latency of a 40 MB
+message from one node process to another through the daemon data plane
+(shared-memory regions + shmem control channels, zero-copy receive).
+
+``vs_baseline`` is the speedup over a same-machine TCP-loopback transfer
+of the same payload (the copying transport a ROS2-style system uses
+locally), measured in the same run.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import statistics
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+SIZE = 40 * 1024 * 1024
+ROUNDS = 30
+
+
+def tcp_loopback_p50_us() -> float:
+    """Baseline: 40 MB over a localhost TCP socket (send + full recv)."""
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    payload = b"x" * SIZE
+    lat: list[float] = []
+
+    def serve():
+        conn, _ = server.accept()
+        with conn:
+            for _ in range(ROUNDS):
+                n = 0
+                while n < SIZE:
+                    chunk = conn.recv(1 << 20)
+                    if not chunk:
+                        return
+                    n += len(chunk)
+                conn.sendall(b"a")  # ack
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    client = socket.create_connection(("127.0.0.1", port))
+    client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    with client:
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter_ns()
+            client.sendall(payload)
+            client.recv(1)
+            lat.append((time.perf_counter_ns() - t0) / 1e3)
+    server.close()
+    return statistics.median(lat)
+
+
+def dataflow_p50_us(workdir: Path) -> float:
+    """40 MB sender -> receiver through the daemon (shmem transport)."""
+    sender = workdir / "bench_sender.py"
+    sender.write_text(textwrap.dedent(f"""
+        import os
+        import time
+
+        from dora_tpu.node import Node
+
+        payload = os.urandom({SIZE})
+        sent = 0
+        with Node() as node:
+            for event in node:
+                if event["type"] != "INPUT":
+                    continue
+                node.send_output("data", payload, {{"t": time.perf_counter_ns()}})
+                sent += 1
+                if sent >= {ROUNDS}:
+                    break
+    """))
+    receiver = workdir / "bench_receiver.py"
+    receiver.write_text(textwrap.dedent(f"""
+        import json
+        import statistics
+        import time
+
+        from dora_tpu.node import Node
+
+        lat = []
+        node = Node()
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            t1 = time.perf_counter_ns()
+            assert len(event["value"]) == {SIZE}
+            lat.append((t1 - event["metadata"]["t"]) / 1e3)
+            if len(lat) >= {ROUNDS}:
+                break
+        node.close()
+        (open("latency.json", "w")
+            .write(json.dumps(statistics.median(lat))))
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "bench-sender",
+                "path": "bench_sender.py",
+                # The timer paces rounds (reference: 10 ms spacing,
+                # examples/benchmark/node/src/main.rs).
+                "inputs": {"tick": "dora/timer/millis/20"},
+                "outputs": ["data"],
+            },
+            {
+                "id": "bench-receiver",
+                "path": "bench_receiver.py",
+                "inputs": {"data": "bench-sender/data"},
+            },
+        ],
+        "communication": {"local": "shmem"},
+    }
+    import yaml
+
+    df = workdir / "bench.yml"
+    df.write_text(yaml.safe_dump(spec))
+
+    from dora_tpu.daemon import run_dataflow
+
+    result = run_dataflow(df, local_comm="shmem", timeout_s=180)
+    if not result.is_ok():
+        raise RuntimeError(f"bench dataflow failed: {result.errors()}")
+    return json.loads((workdir / "latency.json").read_text())
+
+
+def main() -> int:
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-") as tmp:
+        ours = dataflow_p50_us(Path(tmp))
+        baseline = tcp_loopback_p50_us()
+    print(
+        json.dumps(
+            {
+                "metric": "40MB inter-node message p50 latency",
+                "value": round(ours, 1),
+                "unit": "us",
+                "vs_baseline": round(baseline / ours, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

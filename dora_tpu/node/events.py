@@ -217,6 +217,9 @@ class EventStream:
             # which pins the region's export count until they are dropped.
             value: Any = ipc_deserialize(view)
         else:
-            value = bytes(view)  # raw bytes: copy out, ack immediately
-            view.release()
+            # Raw bytes: hand out the mapped view itself — zero-copy, like
+            # the reference's Buffer::from_custom_allocation path. The view
+            # pins the mapping; the drop token is acked when the event is
+            # dropped.
+            value = view
         return value, data.drop_token
