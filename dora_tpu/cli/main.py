@@ -189,22 +189,108 @@ def cmd_start(args) -> int:
         print(uuid)
         if not args.attach:
             return 0
-        return _attach(c, uuid)
+        return _attach(c, uuid, args, working_dir)
 
 
-def _attach(c, uuid: str) -> int:
-    """Poll Check until the dataflow finishes; ctrl-c requests a stop
+def _attach(c, uuid: str, args=None, working_dir: str | None = None) -> int:
+    """Poll Check until the dataflow finishes; ctrl-c requests a stop; a
+    second control connection streams live logs; with --hot-reload,
+    changed Python operator sources trigger a Reload
     (reference: attach.rs:20-209)."""
+    stream_stop = _start_log_stream(args, uuid)
+    watcher = (
+        _HotReloadWatcher(args.dataflow, working_dir)
+        if args is not None and getattr(args, "hot_reload", False)
+        else None
+    )
     try:
         while True:
             reply = c.request(cm.Check(dataflow_uuid=uuid))
             if isinstance(reply, cm.DataflowStopped):
                 return _print_result(reply.result)
+            if watcher is not None:
+                for node_id, operator_id in watcher.changed():
+                    print(f"reloading {node_id}/{operator_id or ''}")
+                    c.request(
+                        cm.ReloadRequest(
+                            dataflow_id=uuid,
+                            node_id=node_id,
+                            operator_id=operator_id,
+                        )
+                    )
             time.sleep(1.0)
     except KeyboardInterrupt:
         print("\nstopping dataflow...")
         reply = c.request(cm.StopRequest(dataflow_uuid=uuid, grace_duration_s=None))
         return _print_result(reply.result)
+    finally:
+        if stream_stop is not None:
+            stream_stop()
+
+
+def _start_log_stream(args, uuid: str):
+    """LogSubscribe on a second connection; prints pushed LogMessages."""
+    import threading
+
+    from dora_tpu.cli.control import ControlConnection
+
+    try:
+        conn = ControlConnection(getattr(args, "coordinator_addr", None))
+    except Exception:
+        return None
+    conn.send_only(cm.LogSubscribe(dataflow_id=uuid, level="info"))
+
+    def pump():
+        try:
+            for msg in conn.stream():
+                node = getattr(msg, "node_id", None) or ""
+                print(f"  [{node}] {getattr(msg, 'message', msg)}")
+        except Exception:
+            pass
+
+    thread = threading.Thread(target=pump, daemon=True)
+    thread.start()
+    return conn.close
+
+
+class _HotReloadWatcher:
+    """mtime-poll Python operator sources of a dataflow
+    (reference: attach.rs file watcher -> Reload)."""
+
+    def __init__(self, dataflow_path: str, working_dir: str | None):
+        from dora_tpu.core.descriptor import (
+            Descriptor,
+            PythonSource,
+            RuntimeNode,
+        )
+
+        self.entries: list[tuple[Path, str, str | None, float]] = []
+        descriptor = Descriptor.read(dataflow_path)
+        base = Path(working_dir or Path(dataflow_path).parent)
+        for node in descriptor.nodes:
+            if not isinstance(node.kind, RuntimeNode):
+                continue
+            for op in node.kind.operators:
+                if isinstance(op.source, PythonSource):
+                    path = Path(op.source.source)
+                    if not path.is_absolute():
+                        path = base / path
+                    if path.exists():
+                        self.entries.append(
+                            (path, str(node.id), str(op.id), path.stat().st_mtime)
+                        )
+
+    def changed(self):
+        out = []
+        for i, (path, node_id, op_id, mtime) in enumerate(self.entries):
+            try:
+                now = path.stat().st_mtime
+            except OSError:
+                continue
+            if now > mtime:
+                self.entries[i] = (path, node_id, op_id, now)
+                out.append((node_id, op_id))
+        return out
 
 
 def _print_result(result) -> int:
@@ -348,6 +434,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("dataflow")
     p.add_argument("--name", default=None)
     p.add_argument("--attach", action="store_true", help="wait for completion")
+    p.add_argument(
+        "--hot-reload",
+        action="store_true",
+        help="with --attach: reload Python operators when their source changes",
+    )
     coordinator_addr(p)
     p.set_defaults(fn=cmd_start)
 

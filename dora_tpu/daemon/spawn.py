@@ -69,6 +69,16 @@ def resolve_command(node: ResolvedNode, working_dir: Path) -> list[str] | str:
     args = shlex.split(custom.args) if custom.args else []
     if source == SHELL_SOURCE:
         return custom.args or ""
+    if "://" in source:
+        # URL-sourced node: fetch once into the cache, then run it
+        # (reference: daemon/src/spawn.rs resolves url sources via
+        # dora-download).
+        from dora_tpu.download import download_file
+
+        local = download_file(source)
+        if local.suffix == ".py":
+            return [sys.executable, str(local)] + args
+        return [str(local)] + args
     if source.startswith("module:"):
         # TPU-build addition: run an installed Python module as the node
         # (equivalent of the reference node-hub's console-script entries).

@@ -1,0 +1,37 @@
+"""Download URL-sourced nodes/operators/dataflows.
+
+Reference parity: libraries/extensions/download (download_file, chmod 764,
+src/lib.rs:27-59). Supports http(s) and file:// URLs; downloads land in a
+per-user cache keyed by URL hash so repeated spawns reuse the file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.request
+from pathlib import Path
+
+CACHE_DIR = Path(
+    os.environ.get("DORA_TPU_CACHE", os.path.expanduser("~/.cache/dora-tpu"))
+)
+
+
+def download_file(url: str, target: str | Path | None = None) -> Path:
+    """Fetch ``url`` to ``target`` (default: URL-hash cache path), mark it
+    executable (rwxrw-r--, like the reference), and return the path."""
+    if target is None:
+        name = Path(url.split("?")[0]).name or "download"
+        digest = hashlib.sha256(url.encode()).hexdigest()[:12]
+        target = CACHE_DIR / digest / name
+    target = Path(target)
+    if target.exists():
+        return target
+    target.parent.mkdir(parents=True, exist_ok=True)
+    tmp = target.with_suffix(target.suffix + ".part")
+    with urllib.request.urlopen(url) as response, open(tmp, "wb") as out:
+        shutil.copyfileobj(response, out)
+    os.replace(tmp, target)
+    os.chmod(target, 0o764)
+    return target

@@ -1,0 +1,34 @@
+"""Checkpoint save/restore (orbax).
+
+Reference parity: SURVEY.md §5.4 — the reference has no checkpointing;
+the TPU build's natural equivalent for model/operator state is orbax.
+Used by the TPU-tier operators (DORA_CHECKPOINT) and by training scripts.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+
+def save(path: str | Path, params: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).resolve()
+    with ocp.StandardCheckpointer() as checkpointer:
+        checkpointer.save(path, params, force=True)
+
+
+def restore(path: str | Path, like: Any) -> Any:
+    """Restore a pytree shaped like ``like`` (template provides structure,
+    dtypes, and shardings)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = Path(path).resolve()
+    template = jax.tree.map(
+        lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(x, "dtype") else x,
+        like,
+    )
+    with ocp.StandardCheckpointer() as checkpointer:
+        return checkpointer.restore(path, template)

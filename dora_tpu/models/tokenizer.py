@@ -1,0 +1,26 @@
+"""Byte-level tokenizer (offline-friendly).
+
+The reference's model nodes pull pretrained HuggingFace tokenizers at
+runtime; this environment is zero-egress, so the framework ships a
+self-contained byte tokenizer: ids 0..255 are raw bytes, 256+ are
+specials. Real checkpoints bring their own vocab via
+dora_tpu.models.checkpoint; every model API takes plain int32 ids either
+way.
+"""
+
+from __future__ import annotations
+
+BOS = 256
+EOS = 257
+PAD = 258
+VOCAB = 259
+
+
+def encode(text: str, bos: bool = True) -> list[int]:
+    ids = list(text.encode("utf-8"))
+    return ([BOS] if bos else []) + ids
+
+
+def decode(ids) -> str:
+    data = bytes(int(i) for i in ids if 0 <= int(i) < 256)
+    return data.decode("utf-8", errors="replace")

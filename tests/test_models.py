@@ -1,0 +1,104 @@
+"""Detection / ASR / VAD model tests (tiny configs, CPU)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from dora_tpu.models import asr, detection, vad
+
+
+class TestDetection:
+    CFG = detection.DetectorConfig.tiny()
+
+    @pytest.fixture(scope="class")
+    def params(self):
+        return detection.init_params(jax.random.PRNGKey(0), self.CFG)
+
+    def test_forward_shapes(self, params):
+        images = jnp.zeros((2, self.CFG.image_size, self.CFG.image_size, 3))
+        preds = detection.forward(params, self.CFG, images)
+        # stem /2, then one /2 per stage: strides 4, 8, 16.
+        cells = sum(
+            (self.CFG.image_size // (2 * 2**s)) ** 2
+            for s in range(1, len(self.CFG.widths))
+        )
+        assert preds.shape == (2, cells, 5 + self.CFG.num_classes)
+
+    def test_detect_static_shapes(self, params):
+        images = jax.random.uniform(
+            jax.random.PRNGKey(1), (2, self.CFG.image_size, self.CFG.image_size, 3)
+        )
+        out = detection.detect(params, self.CFG, images)
+        k = self.CFG.max_detections
+        assert out["boxes"].shape == (2, k, 4)
+        assert out["scores"].shape == (2, k)
+        assert out["classes"].shape == (2, k)
+        assert np.all(np.asarray(out["scores"]) >= 0)
+
+    def test_nms_suppresses_duplicates(self):
+        cfg = self.CFG
+        # Two identical high-score boxes of the same class + one distinct.
+        preds = np.zeros((16, 5 + cfg.num_classes), np.float32)
+        preds[:, 4] = -10.0  # low objectness everywhere
+        for i, (x, score) in enumerate([(10.0, 8.0), (10.0, 7.0), (40.0, 6.0)]):
+            preds[i, 0:4] = [x, 10.0, 8.0, 8.0]
+            preds[i, 4] = score
+            preds[i, 5] = 8.0  # class 0
+        out = detection.postprocess(cfg, jnp.asarray(preds))
+        kept = np.asarray(out["scores"]) > 0
+        assert kept.sum() == 2  # duplicate suppressed
+
+    def test_jit_cached_second_call_fast(self, params):
+        import time
+
+        images = jnp.zeros((1, self.CFG.image_size, self.CFG.image_size, 3))
+        detection.detect(params, self.CFG, images)  # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(detection.detect(params, self.CFG, images))
+        assert time.perf_counter() - t0 < 1.0
+
+
+class TestASR:
+    CFG = asr.ASRConfig.tiny()
+
+    @pytest.fixture(scope="class")
+    def params(self):
+        return asr.init_params(jax.random.PRNGKey(0), self.CFG)
+
+    def test_log_mel_shape(self):
+        audio = jnp.zeros((2, self.CFG.sample_rate // 4))
+        mel = asr.log_mel(self.CFG, audio)
+        assert mel.shape == (2, self.CFG.max_frames, self.CFG.n_mels)
+
+    def test_transcribe_shapes_and_determinism(self, params):
+        audio = jax.random.normal(jax.random.PRNGKey(2), (1, 4000)) * 0.1
+        tokens = asr.transcribe(params, self.CFG, audio, 1, 8)
+        assert tokens.shape == (1, 8)
+        again = asr.transcribe(params, self.CFG, audio, 1, 8)
+        np.testing.assert_array_equal(np.asarray(tokens), np.asarray(again))
+
+
+class TestVAD:
+    CFG = vad.VADConfig.tiny()
+
+    @pytest.fixture(scope="class")
+    def params(self):
+        return vad.init_params(jax.random.PRNGKey(0), self.CFG)
+
+    def test_prob_and_state_threading(self, params):
+        audio = jax.random.normal(jax.random.PRNGKey(3), (2, 1024)) * 0.1
+        prob, h = vad.speech_prob(params, self.CFG, audio)
+        assert prob.shape == (2,)
+        assert np.all((np.asarray(prob) >= 0) & (np.asarray(prob) <= 1))
+        prob2, h2 = vad.speech_prob(params, self.CFG, audio, h)
+        assert h2.shape == h.shape
+        assert not np.allclose(np.asarray(h), np.asarray(h2))
+
+    def test_segment_smoothing(self):
+        probs = np.array([0.9, 0.2, 0.9, 0.9, 0.1, 0.1, 0.8])
+        mask = vad.segment_speech(probs, threshold=0.5)
+        assert mask.tolist() == [True, True, True, True, False, False, True]

@@ -1,0 +1,168 @@
+"""Node-hub chain tests: camera → TPU detector, microphone → VAD+ASR,
+recorder — the BASELINE.json config shapes at tiny model sizes.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import yaml
+
+from dora_tpu.daemon import run_dataflow
+
+
+def run(tmp_path, spec, timeout_s=180):
+    path = tmp_path / "dataflow.yml"
+    path.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(path, timeout_s=timeout_s)
+    assert result.is_ok(), result.errors()
+    return result
+
+
+def test_camera_detector_chain(tmp_path):
+    """camera → fused jax detector → checker (yolo-chain parity)."""
+    checker = tmp_path / "check_boxes.py"
+    checker.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+        from dora_tpu.tpu.bridge import arrow_to_host
+
+        node = Node()
+        got = 0
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            boxes = arrow_to_host(event["value"], event["metadata"])
+            assert boxes.shape == (10, 4), boxes.shape
+            got += 1
+        node.close()
+        assert got >= 2, got
+        print(f"checked {got} detections")
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "camera",
+                "path": "module:dora_tpu.nodehub.camera",
+                "inputs": {"tick": "dora/timer/millis/50"},
+                "outputs": ["image"],
+                "env": {
+                    "IMAGE_WIDTH": "64",
+                    "IMAGE_HEIGHT": "64",
+                    "MAX_FRAMES": "6",
+                },
+            },
+            {
+                "id": "detector",
+                "operator": {
+                    "jax": "dora_tpu.nodehub.ops:make_detector",
+                    "inputs": {
+                        "image": {"source": "camera/image", "queue_size": 1}
+                    },
+                    "outputs": ["boxes", "scores", "classes"],
+                },
+            },
+            {
+                "id": "checker",
+                "path": "check_boxes.py",
+                "inputs": {"boxes": "detector/op/boxes"},
+            },
+        ]
+    }
+    run(tmp_path, spec)
+    log_dir = next((tmp_path / "out").iterdir())
+    assert "checked" in (log_dir / "log_checker.txt").read_text()
+
+
+def test_speech_chain_fused_vad_asr(tmp_path):
+    """microphone → one runtime node fusing VAD + ASR (audio-chain parity);
+    VAD GRU state threads across ticks on device."""
+    checker = tmp_path / "check_speech.py"
+    checker.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        node = Node()
+        probs = tokens = 0
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            if event["id"] == "prob":
+                probs += 1
+            else:
+                tokens += 1
+        node.close()
+        assert probs >= 2 and tokens >= 2, (probs, tokens)
+        print(f"speech ok: {probs} probs, {tokens} token batches")
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "microphone",
+                "path": "module:dora_tpu.nodehub.microphone",
+                "inputs": {"tick": "dora/timer/millis/60"},
+                "outputs": ["audio"],
+                "env": {"MAX_CHUNKS": "5", "MAX_DURATION": "0.05"},
+            },
+            {
+                "id": "speech",
+                "operators": [
+                    {
+                        "id": "vad",
+                        "jax": "dora_tpu.nodehub.ops:make_vad",
+                        "inputs": {
+                            "audio": {
+                                "source": "microphone/audio",
+                                "queue_size": 1,
+                            }
+                        },
+                        "outputs": ["prob"],
+                    },
+                    {
+                        "id": "asr",
+                        "jax": "dora_tpu.nodehub.ops:make_asr",
+                        "inputs": {
+                            "audio": {
+                                "source": "microphone/audio",
+                                "queue_size": 1,
+                            }
+                        },
+                        "outputs": ["tokens"],
+                    },
+                ],
+            },
+            {
+                "id": "checker",
+                "path": "check_speech.py",
+                "inputs": {
+                    "prob": "speech/vad/prob",
+                    "tokens": "speech/asr/tokens",
+                },
+            },
+        ]
+    }
+    run(tmp_path, spec)
+
+
+def test_record_node(tmp_path):
+    """pyarrow-sender → recorder writes readable Parquet with timestamps."""
+    spec = {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[1, 2]", "COUNT": "3"},
+            },
+            {
+                "id": "recorder",
+                "path": "module:dora_tpu.nodehub.record",
+                "inputs": {"data": "sender/data"},
+                "env": {"RECORD_DIR": str(tmp_path / "rec")},
+            },
+        ]
+    }
+    run(tmp_path, spec)
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(tmp_path / "rec" / "data.parquet")
+    assert table.num_rows == 3
+    assert "timestamp_utc_ns" in table.column_names
