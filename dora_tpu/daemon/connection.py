@@ -80,14 +80,17 @@ class ShmemConnection(NodeConnection):
         self._thread.start()
 
     def _pump(self) -> None:
-        while not self._closing:
-            try:
-                data = self.channel.recv(self.RECV_TICK_S)
-            except (Disconnected, Exception):
-                break
-            if data is not None:
-                self._loop.call_soon_threadsafe(self._incoming.put_nowait, data)
-        self._loop.call_soon_threadsafe(self._incoming.put_nowait, None)
+        try:
+            while not self._closing:
+                try:
+                    data = self.channel.recv(self.RECV_TICK_S)
+                except (Disconnected, Exception):
+                    break
+                if data is not None:
+                    self._loop.call_soon_threadsafe(self._incoming.put_nowait, data)
+            self._loop.call_soon_threadsafe(self._incoming.put_nowait, None)
+        except RuntimeError:
+            pass  # event loop closed during teardown
 
     async def recv(self) -> bytes | None:
         return await self._incoming.get()

@@ -304,7 +304,12 @@ class Daemon:
             outputs=[str(o) for o in node.outputs],
         )
         if self.local_comm == "shmem":
-            prefix = f"dtp-{df.id[:8]}-{node_id}"
+            import uuid as uuid_mod
+
+            # Random component: uuid7 time prefixes repeat across nearby
+            # runs, and a crashed run's leaked segments must never collide
+            # with a new one (shm_open O_EXCL would fail).
+            prefix = f"dtp-{df.id[:8]}-{uuid_mod.uuid4().hex[:8]}-{node_id}"
             comm: Any = d2n.ShmemCommunication(
                 control_region_id=f"{prefix}-ctl",
                 events_region_id=f"{prefix}-evt",

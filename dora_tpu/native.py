@@ -53,6 +53,38 @@ def build_native(force: bool = False) -> Path:
     return _LIB
 
 
+def build_node_api(force: bool = False) -> Path:
+    """Compile the C/C++ node API (native/node_api.cpp + shmem.cpp) into
+    dora_tpu/libdora_node_api.so for C/C++ nodes to link against."""
+    import hashlib
+
+    native_dir = _HERE.parent / "native"
+    sources = [native_dir / "node_api.cpp", native_dir / "shmem.cpp"]
+    headers = [native_dir / "dora_node_api.h", native_dir / "dtp_shmem.h",
+               native_dir / "msgpack.hpp"]
+    lib = _HERE / "libdora_node_api.so"
+    stamp = _HERE / "libdora_node_api.build-id"
+    digest = hashlib.sha256(
+        b"".join(p.read_bytes() for p in sources + headers)
+    ).hexdigest()[:16]
+    if lib.exists() and not force and stamp.exists() \
+            and stamp.read_text().strip() == digest:
+        return lib
+    tmp = _HERE / f"libdora_node_api.{os.getpid()}.tmp.so"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-I", str(native_dir), "-o", str(tmp),
+        *[str(s) for s in sources], "-lrt", "-pthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, lib)
+        stamp.write_text(digest)
+    finally:
+        tmp.unlink(missing_ok=True)
+    return lib
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     with _lock:

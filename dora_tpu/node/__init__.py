@@ -177,6 +177,10 @@ class Node:
         """Next event; None when the stream ended or ``timeout`` expired."""
         return self._events.recv(timeout)
 
+    @property
+    def stream_ended(self) -> bool:
+        return self._events.ended
+
     #: dora Python API compatibility alias.
     next = recv
 

@@ -95,6 +95,11 @@ class EventStream:
 
     # -- user side ----------------------------------------------------------
 
+    @property
+    def ended(self) -> bool:
+        """True once the stream closed (all inputs closed / daemon gone)."""
+        return self._closed.is_set() and self._queue.empty()
+
     def recv(self, timeout: float | None = None) -> Event | None:
         """Next event, or None when the stream ended (or timeout expired)."""
         if self._closed.is_set() and self._queue.empty():

@@ -1,0 +1,140 @@
+"""OpenAI-compatible chat endpoint bridging HTTP into a dataflow.
+
+Reference parity: node-hub/dora-openai-server (FastAPI) and
+node-hub/openai-proxy-server (Rust hyper): POST /v1/chat/completions
+publishes the user text on the ``text`` output and returns the next value
+arriving on the ``response`` input. Stdlib http.server — no web-framework
+dependency.
+
+Dataflow usage::
+
+    - id: api
+      path: module:dora_tpu.nodehub.openai_server
+      outputs: [text]
+      inputs: {response: llm/op/tokens}
+      env: {PORT: "8123"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pyarrow as pa
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    port = int(os.environ.get("PORT", "8123"))
+    timeout_s = float(os.environ.get("RESPONSE_TIMEOUT", "30"))
+    max_requests = int(os.environ.get("MAX_REQUESTS", "0"))  # 0 = serve forever
+    node = Node()
+    responses: queue.Queue = queue.Queue()
+    send_lock = threading.Lock()
+    served = [0]
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            if self.path == "/v1/models":
+                self._json(
+                    {"object": "list",
+                     "data": [{"id": "dora-tpu", "object": "model"}]}
+                )
+            else:
+                self.send_error(404)
+
+        def do_POST(self):
+            if self.path != "/v1/chat/completions":
+                self.send_error(404)
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                messages = body.get("messages", [])
+                text = next(
+                    (m.get("content", "") for m in reversed(messages)
+                     if m.get("role") == "user"),
+                    "",
+                )
+            except (ValueError, AttributeError) as e:
+                self.send_error(400, str(e))
+                return
+            with send_lock:
+                # Drain stale responses, publish, await the next one.
+                while not responses.empty():
+                    responses.get_nowait()
+                node.send_output("text", pa.array([text]))
+                try:
+                    answer = responses.get(timeout=timeout_s)
+                except queue.Empty:
+                    self.send_error(504, "dataflow did not answer in time")
+                    return
+                served[0] += 1
+            self._json(
+                {
+                    "id": "chatcmpl-dora-tpu",
+                    "object": "chat.completion",
+                    "created": int(time.time()),
+                    "model": body.get("model", "dora-tpu"),
+                    "choices": [
+                        {
+                            "index": 0,
+                            "message": {"role": "assistant", "content": answer},
+                            "finish_reason": "stop",
+                        }
+                    ],
+                }
+            )
+
+        def _json(self, payload: dict):
+            data = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    print(f"openai server listening on 127.0.0.1:{server.server_address[1]}")
+
+    try:
+        while True:
+            if max_requests and served[0] >= max_requests:
+                break
+            event = node.recv(timeout=0.25)
+            if event is None:
+                if node.stream_ended:
+                    break
+                continue
+            if event["type"] == "STOP":
+                break
+            if event["type"] != "INPUT":
+                continue
+            value = event["value"]
+            if isinstance(value, pa.Array):
+                items = value.to_pylist()
+                if items and isinstance(items[0], str):
+                    answer = " ".join(str(i) for i in items)
+                else:
+                    from dora_tpu.models import tokenizer
+
+                    answer = tokenizer.decode(items)
+            else:
+                answer = bytes(value or b"").decode(errors="replace")
+            responses.put(answer)
+    finally:
+        server.shutdown()
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""ROS2 interoperability.
+
+Reference parity: libraries/extensions/ros2-bridge (+msg-gen, +python) —
+compilation-free ROS2 interop: message definitions (.msg/.srv/.action)
+are parsed at runtime into typed schemas, converted to/from Arrow, and
+bridged over DDS. Here:
+
+  * ``msg_parser`` — the IDL parser + schema model (mirrors msg-gen's
+    parser, which the reference unit-tests; so do we);
+  * ``arrow_convert`` — schema-driven dict ⇄ Arrow struct conversion
+    (mirrors ros2-bridge/python's typed serialize/deserialize);
+  * ``bridge`` — the transport; requires ``rclpy`` (a ROS2 install) and
+    degrades to a clear error without it, like the reference's
+    feature-gated builds.
+"""
+
+from dora_tpu.ros2.msg_parser import (
+    ActionSpec,
+    Field,
+    MessageSpec,
+    ServiceSpec,
+    TypeRef,
+    find_interface,
+    parse_action,
+    parse_message,
+    parse_service,
+)
+
+__all__ = [
+    "ActionSpec",
+    "Field",
+    "MessageSpec",
+    "ServiceSpec",
+    "TypeRef",
+    "find_interface",
+    "parse_action",
+    "parse_message",
+    "parse_service",
+]

@@ -1,0 +1,104 @@
+"""Schema-driven ROS2 message ⇄ Arrow conversion.
+
+Reference parity: libraries/extensions/ros2-bridge/python/src/typed/
+{serialize,deserialize} — ROS2 messages become Arrow struct arrays keyed
+by field name, recursively for nested message types.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import pyarrow as pa
+
+from dora_tpu.ros2.msg_parser import MessageSpec, TypeRef
+
+_PRIMITIVE_ARROW = {
+    "bool": pa.bool_(),
+    "byte": pa.uint8(),
+    "char": pa.uint8(),
+    "int8": pa.int8(),
+    "uint8": pa.uint8(),
+    "int16": pa.int16(),
+    "uint16": pa.uint16(),
+    "int32": pa.int32(),
+    "uint32": pa.uint32(),
+    "int64": pa.int64(),
+    "uint64": pa.uint64(),
+    "float32": pa.float32(),
+    "float64": pa.float64(),
+    "string": pa.string(),
+    "wstring": pa.string(),
+}
+
+
+def arrow_type(
+    spec: MessageSpec, resolve: Callable[[str], MessageSpec] | None = None
+) -> pa.StructType:
+    """The Arrow struct type for one message spec; nested message types are
+    resolved through ``resolve`` (e.g. ros2.find_interface)."""
+
+    def field_type(t: TypeRef) -> pa.DataType:
+        if t.is_primitive:
+            base = _PRIMITIVE_ARROW[t.base]
+        else:
+            if resolve is None:
+                raise ValueError(f"cannot resolve nested type {t.base!r}")
+            base = arrow_type(resolve(t.base), resolve)
+        if t.is_array:
+            if t.array_size is not None:
+                return pa.list_(base, t.array_size)
+            return pa.list_(base)
+        return base
+
+    return pa.struct(
+        [pa.field(f.name, field_type(f.type)) for f in spec.fields]
+    )
+
+
+def to_arrow(
+    messages: list[dict],
+    spec: MessageSpec,
+    resolve: Callable[[str], MessageSpec] | None = None,
+) -> pa.Array:
+    """List of message dicts -> Arrow struct array (defaults filled in)."""
+    typed = arrow_type(spec, resolve)
+    filled = [_fill_defaults(m, spec) for m in messages]
+    return pa.array(filled, type=typed)
+
+
+def from_arrow(array: pa.Array) -> list[dict]:
+    """Arrow struct array -> list of message dicts."""
+    return array.to_pylist()
+
+
+def _fill_defaults(message: dict, spec: MessageSpec) -> dict:
+    out = {}
+    for f in spec.fields:
+        if f.name in message:
+            out[f.name] = message[f.name]
+        elif f.default is not None:
+            out[f.name] = f.default
+        else:
+            out[f.name] = _zero(f.type)
+    return out
+
+
+def _zero(t: TypeRef) -> Any:
+    if t.is_array:
+        if t.array_size is not None:
+            return [_zero_scalar(t)] * t.array_size
+        return []
+    return _zero_scalar(t)
+
+
+def _zero_scalar(t: TypeRef) -> Any:
+    if t.base == "bool":
+        return False
+    if t.base in ("string", "wstring"):
+        return ""
+    if t.base.startswith("float"):
+        return 0.0
+    if t.is_primitive:
+        return 0
+    return {}

@@ -1,0 +1,113 @@
+"""ROS2 DDS transport bridge (requires a ROS2 installation with rclpy).
+
+Reference parity: the ros2-bridge runtime half — Ros2Node/publisher/
+subscription with subscriptions mergeable into a dora node's event
+stream (apis/python/node/src/lib.rs:209-239). The reference links rustdds
+directly; the Python-native equivalent rides rclpy. Without rclpy this
+module still imports (the parser/Arrow layers work standalone) but
+constructing a context raises with a clear message.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+from dora_tpu.ros2 import find_interface
+from dora_tpu.ros2.arrow_convert import from_arrow, to_arrow
+
+
+def _require_rclpy():
+    try:
+        import rclpy  # noqa: F401
+
+        return rclpy
+    except ImportError as e:
+        raise RuntimeError(
+            "the ROS2 bridge transport requires rclpy (source a ROS2 "
+            "installation); the message parser and Arrow conversion work "
+            "without it"
+        ) from e
+
+
+class Ros2Context:
+    """Owns the rclpy init + a background spin thread."""
+
+    def __init__(self, args=None):
+        self._rclpy = _require_rclpy()
+        self._rclpy.init(args=args)
+        self._nodes: list[Any] = []
+
+    def node(self, name: str, namespace: str = "/") -> "Ros2Node":
+        node = Ros2Node(self, name, namespace)
+        self._nodes.append(node)
+        return node
+
+    def close(self) -> None:
+        for node in self._nodes:
+            node.close()
+        self._rclpy.shutdown()
+
+
+class Ros2Node:
+    def __init__(self, context: Ros2Context, name: str, namespace: str):
+        rclpy = context._rclpy
+        self._node = rclpy.create_node(name, namespace=namespace)
+        self._executor = rclpy.executors.SingleThreadedExecutor()
+        self._executor.add_node(self._node)
+        self._thread = threading.Thread(target=self._executor.spin, daemon=True)
+        self._thread.start()
+
+    def publisher(self, topic: str, msg_type: str, qos_depth: int = 10):
+        msg_cls = _import_msg(msg_type)
+        pub = self._node.create_publisher(msg_cls, topic, qos_depth)
+        spec = find_interface(msg_type)
+
+        class _Publisher:
+            def publish(self, value):
+                """value: dict, or an Arrow struct array of one element."""
+                import pyarrow as pa
+
+                if isinstance(value, pa.Array):
+                    value = from_arrow(value)[0]
+                msg = msg_cls()
+                for k, v in value.items():
+                    setattr(msg, k, v)
+                pub.publish(msg)
+
+        return _Publisher()
+
+    def subscription(self, topic: str, msg_type: str, qos_depth: int = 10):
+        """A subscription whose ``recv``/queue yields Arrow struct arrays —
+        merge it into a dora node loop."""
+        msg_cls = _import_msg(msg_type)
+        spec = find_interface(msg_type)
+        out: queue.Queue = queue.Queue()
+
+        def on_msg(msg):
+            value = {f.name: getattr(msg, f.name) for f in spec.fields}
+            out.put(to_arrow([value], spec, resolve=find_interface))
+
+        self._node.create_subscription(msg_cls, topic, on_msg, qos_depth)
+
+        class _Subscription:
+            queue = out
+
+            def recv(self, timeout: float | None = None):
+                try:
+                    return out.get(timeout=timeout)
+                except queue.Empty:
+                    return None
+
+        return _Subscription()
+
+    def close(self) -> None:
+        self._executor.shutdown()
+
+
+def _import_msg(full_name: str):
+    """'std_msgs/String' -> the rclpy message class."""
+    package, _, name = full_name.partition("/")
+    module = __import__(f"{package}.msg", fromlist=[name])
+    return getattr(module, name)

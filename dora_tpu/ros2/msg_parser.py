@@ -1,0 +1,238 @@
+"""ROS2 interface-definition parser (.msg / .srv / .action).
+
+Reference parity: libraries/extensions/ros2-bridge/msg-gen/src/parser —
+the reference generates Rust types at build time; we parse at runtime
+into schema objects that drive Arrow conversion. Grammar covered:
+primitive and namespaced types, fixed/bounded/unbounded arrays, bounded
+strings, default values, constants, comments.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+PRIMITIVES = {
+    "bool", "byte", "char",
+    "int8", "uint8", "int16", "uint16", "int32", "uint32", "int64", "uint64",
+    "float32", "float64", "string", "wstring",
+}
+
+_TYPE_RE = re.compile(
+    r"^(?P<base>[A-Za-z0-9_/]+)"
+    r"(?:<=(?P<strbound>\d+))?"
+    r"(?P<array>\[(?:(?P<size>\d+)|<=(?P<bound>\d+))?\])?$"
+)
+_CONST_RE = re.compile(
+    r"^(?P<type>\S+)\s+(?P<name>[A-Z][A-Z0-9_]*)\s*=\s*(?P<value>.+)$"
+)
+_FIELD_RE = re.compile(
+    r"^(?P<type>\S+)\s+(?P<name>[a-zA-Z][a-zA-Z0-9_]*)(?:\s+(?P<default>.+))?$"
+)
+
+
+@dataclass(frozen=True)
+class TypeRef:
+    """A (possibly array) field type."""
+
+    base: str  # primitive name or "pkg/Type"
+    is_array: bool = False
+    array_size: int | None = None  # fixed size
+    array_bound: int | None = None  # bounded (<=N)
+    string_bound: int | None = None  # bounded string payload
+
+    @property
+    def is_primitive(self) -> bool:
+        return self.base in PRIMITIVES
+
+    @property
+    def package(self) -> str | None:
+        return self.base.split("/")[0] if "/" in self.base else None
+
+    @classmethod
+    def parse(cls, raw: str, package: str | None = None) -> "TypeRef":
+        m = _TYPE_RE.match(raw)
+        if not m:
+            raise ValueError(f"invalid type {raw!r}")
+        base = m.group("base")
+        if base not in PRIMITIVES and "/" not in base and package:
+            # Relative reference to a message in the same package.
+            base = f"{package}/{base}"
+        return cls(
+            base=base,
+            is_array=m.group("array") is not None,
+            array_size=int(m.group("size")) if m.group("size") else None,
+            array_bound=int(m.group("bound")) if m.group("bound") else None,
+            string_bound=int(m.group("strbound")) if m.group("strbound") else None,
+        )
+
+
+@dataclass(frozen=True)
+class Field:
+    type: TypeRef
+    name: str
+    default: object = None
+
+
+@dataclass(frozen=True)
+class Constant:
+    type: str
+    name: str
+    value: object
+
+
+@dataclass(frozen=True)
+class MessageSpec:
+    package: str
+    name: str
+    fields: tuple[Field, ...] = ()
+    constants: tuple[Constant, ...] = ()
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.package}/{self.name}"
+
+
+@dataclass(frozen=True)
+class ServiceSpec:
+    package: str
+    name: str
+    request: MessageSpec = None
+    response: MessageSpec = None
+
+
+@dataclass(frozen=True)
+class ActionSpec:
+    package: str
+    name: str
+    goal: MessageSpec = None
+    result: MessageSpec = None
+    feedback: MessageSpec = None
+
+
+def _parse_value(type_name: str, raw: str):
+    raw = raw.strip()
+    if type_name == "bool":
+        return raw.lower() in ("true", "1")
+    if type_name in ("string", "wstring") or raw.startswith(("'", '"')):
+        try:
+            return ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            return raw
+    if raw.startswith("["):
+        return ast.literal_eval(raw)
+    try:
+        return ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        return raw
+
+
+def _strip_comment(line: str) -> str:
+    # A '#' inside a quoted string is not a comment.
+    out = []
+    quote = None
+    for c in line:
+        if quote:
+            out.append(c)
+            if c == quote:
+                quote = None
+        elif c in "'\"":
+            quote = c
+            out.append(c)
+        elif c == "#":
+            break
+        else:
+            out.append(c)
+    return "".join(out).strip()
+
+
+def parse_message(text: str, package: str = "", name: str = "Msg") -> MessageSpec:
+    fields: list[Field] = []
+    constants: list[Constant] = []
+    for raw_line in text.splitlines():
+        line = _strip_comment(raw_line)
+        if not line:
+            continue
+        const = _CONST_RE.match(line)
+        if const:
+            constants.append(
+                Constant(
+                    type=const.group("type"),
+                    name=const.group("name"),
+                    value=_parse_value(const.group("type"), const.group("value")),
+                )
+            )
+            continue
+        m = _FIELD_RE.match(line)
+        if not m:
+            raise ValueError(f"cannot parse line: {raw_line!r}")
+        type_ref = TypeRef.parse(m.group("type"), package)
+        default = m.group("default")
+        fields.append(
+            Field(
+                type=type_ref,
+                name=m.group("name"),
+                default=_parse_value(type_ref.base, default) if default else None,
+            )
+        )
+    return MessageSpec(
+        package=package, name=name, fields=tuple(fields), constants=tuple(constants)
+    )
+
+
+def parse_service(text: str, package: str = "", name: str = "Srv") -> ServiceSpec:
+    parts = _split_sections(text, 2)
+    return ServiceSpec(
+        package=package,
+        name=name,
+        request=parse_message(parts[0], package, f"{name}_Request"),
+        response=parse_message(parts[1], package, f"{name}_Response"),
+    )
+
+
+def parse_action(text: str, package: str = "", name: str = "Action") -> ActionSpec:
+    parts = _split_sections(text, 3)
+    return ActionSpec(
+        package=package,
+        name=name,
+        goal=parse_message(parts[0], package, f"{name}_Goal"),
+        result=parse_message(parts[1], package, f"{name}_Result"),
+        feedback=parse_message(parts[2], package, f"{name}_Feedback"),
+    )
+
+
+def _split_sections(text: str, n: int) -> list[str]:
+    parts = re.split(r"^---\s*$", text, flags=re.MULTILINE)
+    if len(parts) != n:
+        raise ValueError(f"expected {n} sections separated by '---', got {len(parts)}")
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# interface discovery (reference: scan $AMENT_PREFIX_PATH)
+# ---------------------------------------------------------------------------
+
+
+def find_interface(full_name: str, ament_prefix_path: str | None = None):
+    """Locate and parse ``pkg/Type`` under $AMENT_PREFIX_PATH
+    (``<prefix>/share/<pkg>/{msg,srv,action}/<Type>.{msg,srv,action}``)."""
+    package, _, name = full_name.partition("/")
+    prefixes = (ament_prefix_path or os.environ.get("AMENT_PREFIX_PATH", "")).split(
+        os.pathsep
+    )
+    for prefix in filter(None, prefixes):
+        share = Path(prefix) / "share" / package
+        for kind, ext, parser in (
+            ("msg", ".msg", parse_message),
+            ("srv", ".srv", parse_service),
+            ("action", ".action", parse_action),
+        ):
+            path = share / kind / f"{name}{ext}"
+            if path.exists():
+                return parser(path.read_text(), package, name)
+    raise FileNotFoundError(
+        f"interface {full_name!r} not found under AMENT_PREFIX_PATH"
+    )

@@ -1,0 +1,188 @@
+"""Publish/subscribe communication layer.
+
+Reference parity: libraries/communication-layer/pub-sub — a
+CommunicationLayer/Publisher/Subscriber abstraction with a Zenoh backend
+that the main path does not use (remote config only admits TCP,
+libraries/core/src/config.rs:360-369). Here: the same abstraction with a
+TCP broker backend that works out of the box (one process hosts the
+broker; publishers/subscribers connect by topic); a zenoh backend slot is
+gated on the optional ``zenoh`` package.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable
+
+from dora_tpu.transport.framing import (
+    ConnectionClosed,
+    recv_frame,
+    send_frame,
+)
+
+
+class CommunicationLayer:
+    """Abstract pub/sub layer."""
+
+    def publisher(self, topic: str) -> "Publisher":
+        raise NotImplementedError
+
+    def subscribe(self, topic: str, callback: Callable[[bytes], None]) -> "Subscription":
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class Publisher:
+    def publish(self, data: bytes) -> None:
+        raise NotImplementedError
+
+
+class Subscription:
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# TCP broker backend
+# ---------------------------------------------------------------------------
+
+
+class Broker:
+    """Minimal topic broker: clients send [kind(1B)][topic][0][payload]
+    frames; SUB registers interest, PUB fans out to subscribers."""
+
+    def __init__(self, port: int = 0):
+        self._server = socket.socket()
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("127.0.0.1", port))
+        self._server.listen(64)
+        self.port = self._server.getsockname()[1]
+        self._subs: dict[str, list[socket.socket]] = {}
+        self._lock = threading.Lock()
+        self._closing = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _client_loop(self, conn: socket.socket):
+        try:
+            while True:
+                frame = recv_frame(conn)
+                kind, topic, payload = _split(frame)
+                if kind == b"S"[0]:
+                    with self._lock:
+                        self._subs.setdefault(topic, []).append(conn)
+                elif kind == b"P"[0]:
+                    with self._lock:
+                        targets = list(self._subs.get(topic, ()))
+                    dead = []
+                    for t in targets:
+                        try:
+                            send_frame(t, b"M" + topic.encode() + b"\0" + payload)
+                        except OSError:
+                            dead.append(t)
+                    if dead:
+                        with self._lock:
+                            for t in dead:
+                                self._subs[topic].remove(t)
+        except (ConnectionClosed, OSError):
+            pass
+        finally:
+            with self._lock:
+                for subs in self._subs.values():
+                    if conn in subs:
+                        subs.remove(conn)
+
+    def close(self):
+        self._closing = True
+        self._server.close()
+
+
+def _split(frame: bytes) -> tuple[int, str, bytes]:
+    kind = frame[0]
+    sep = frame.index(0, 1)
+    return kind, frame[1:sep].decode(), frame[sep + 1 :]
+
+
+class TcpPubSub(CommunicationLayer):
+    def __init__(self, broker_addr: str):
+        host, _, port = broker_addr.rpartition(":")
+        self._addr = (host, int(port))
+        self._pub_sock: socket.socket | None = None
+        self._pub_lock = threading.Lock()
+        self._subscriptions: list[_TcpSubscription] = []
+
+    def publisher(self, topic: str) -> Publisher:
+        layer = self
+
+        class _Pub(Publisher):
+            def publish(self, data: bytes) -> None:
+                with layer._pub_lock:
+                    if layer._pub_sock is None:
+                        layer._pub_sock = socket.create_connection(layer._addr)
+                    send_frame(
+                        layer._pub_sock, b"P" + topic.encode() + b"\0" + data
+                    )
+
+        return _Pub()
+
+    def subscribe(self, topic: str, callback) -> Subscription:
+        sock = socket.create_connection(self._addr)
+        send_frame(sock, b"S" + topic.encode() + b"\0")
+        sub = _TcpSubscription(sock, callback)
+        self._subscriptions.append(sub)
+        return sub
+
+    def close(self) -> None:
+        with self._pub_lock:
+            if self._pub_sock is not None:
+                self._pub_sock.close()
+        for sub in self._subscriptions:
+            sub.close()
+
+
+class _TcpSubscription(Subscription):
+    def __init__(self, sock: socket.socket, callback):
+        self._sock = sock
+        self._callback = callback
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while True:
+                frame = recv_frame(self._sock)
+                _, _, payload = _split(frame)
+                self._callback(payload)
+        except (ConnectionClosed, OSError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def zenoh_layer(*args, **kwargs) -> CommunicationLayer:  # pragma: no cover
+    """Zenoh backend (reference: pub-sub/src/zenoh.rs) — requires the
+    optional ``zenoh`` package, which this environment does not ship."""
+    try:
+        import zenoh  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "the zenoh pub/sub backend requires the 'zenoh' package"
+        ) from e
+    raise NotImplementedError("zenoh backend: planned")

@@ -1,0 +1,72 @@
+// dora-tpu C node API.
+//
+// Reference parity: apis/c/node/node_api.h — init a node from the
+// environment, iterate events, send outputs. Payloads are raw bytes or
+// Arrow IPC streams (check dora_event_encoding); payloads >= 4 KiB move
+// zero-copy through shared-memory regions in both directions.
+//
+// Link: -ldora_node_api (built by `python -m dora_tpu.native_node_api`)
+// or compile dora_node_api.cpp + shmem.cpp into your node directly.
+
+#ifndef DORA_TPU_NODE_API_H
+#define DORA_TPU_NODE_API_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct DoraContext DoraContext;
+typedef struct DoraEvent DoraEvent;
+
+typedef enum {
+  DORA_EVENT_INPUT = 0,
+  DORA_EVENT_INPUT_CLOSED = 1,
+  DORA_EVENT_STOP = 2,
+  DORA_EVENT_RELOAD = 3,
+  DORA_EVENT_ERROR = 4,
+} DoraEventType;
+
+// Connect to the daemon using DORA_NODE_CONFIG from the environment
+// (the daemon sets it when spawning the node). NULL on failure; see
+// dora_last_error().
+DoraContext* dora_init_from_env(void);
+
+// Report outputs done, flush drop-token acks, tear down channels.
+void dora_close(DoraContext* ctx);
+
+const char* dora_node_id(const DoraContext* ctx);
+const char* dora_dataflow_id(const DoraContext* ctx);
+const char* dora_last_error(DoraContext* ctx);
+
+// Blocking next event; NULL when the stream ended (all inputs closed or
+// daemon shut down). Free every event with dora_event_free.
+DoraEvent* dora_next_event(DoraContext* ctx);
+
+DoraEventType dora_event_type(const DoraEvent* event);
+// Input id ("<name>" / "<operator>/<name>"); NULL for STOP.
+const char* dora_event_id(const DoraEvent* event);
+// "raw" or "arrow-ipc" (Arrow IPC stream readable with Arrow C++/GLib).
+const char* dora_event_encoding(const DoraEvent* event);
+// Payload bytes; zero-copy into the shared-memory region for large
+// payloads — valid until dora_event_free.
+const unsigned char* dora_event_data(const DoraEvent* event, size_t* len);
+// Releases payload buffers and acknowledges the shared-memory drop token.
+void dora_event_free(DoraContext* ctx, DoraEvent* event);
+
+// Send one output. encoding: "raw" (opaque bytes) or "arrow-ipc" (data is
+// an Arrow IPC stream you produced). Payloads >= 4096 bytes are placed in
+// a shared-memory region (cached and recycled via drop tokens).
+// Returns 0 on success, nonzero on error (see dora_last_error).
+int dora_send_output(DoraContext* ctx, const char* output_id,
+                     const unsigned char* data, size_t len);
+int dora_send_output_enc(DoraContext* ctx, const char* output_id,
+                         const unsigned char* data, size_t len,
+                         const char* encoding);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // DORA_TPU_NODE_API_H

@@ -1,0 +1,81 @@
+// dora-tpu C++ node API: RAII convenience over the C ABI.
+//
+// Reference parity: apis/c++/node (cxx-bridge wrapper). Usage:
+//
+//   dora::Node node;                       // init from env, throws on error
+//   while (auto event = node.next()) {
+//     if (event.type() == DORA_EVENT_INPUT)
+//       node.send_output("out", event.data(), event.size());
+//   }
+
+#pragma once
+
+#include <stdexcept>
+#include <string>
+#include <utility>
+
+#include "dora_node_api.h"
+
+namespace dora {
+
+class Event {
+ public:
+  Event(DoraContext* ctx, DoraEvent* event) : ctx_(ctx), event_(event) {}
+  Event(Event&& other) noexcept
+      : ctx_(other.ctx_), event_(std::exchange(other.event_, nullptr)) {}
+  Event(const Event&) = delete;
+  Event& operator=(const Event&) = delete;
+  ~Event() {
+    if (event_) dora_event_free(ctx_, event_);
+  }
+
+  explicit operator bool() const { return event_ != nullptr; }
+  DoraEventType type() const { return dora_event_type(event_); }
+  std::string id() const {
+    const char* id = dora_event_id(event_);
+    return id ? id : "";
+  }
+  std::string encoding() const { return dora_event_encoding(event_); }
+  const unsigned char* data() const {
+    size_t len;
+    return dora_event_data(event_, &len);
+  }
+  size_t size() const {
+    size_t len;
+    dora_event_data(event_, &len);
+    return len;
+  }
+
+ private:
+  DoraContext* ctx_;
+  DoraEvent* event_;
+};
+
+class Node {
+ public:
+  Node() : ctx_(dora_init_from_env()) {
+    if (!ctx_) throw std::runtime_error("dora: node init failed");
+  }
+  Node(const Node&) = delete;
+  Node& operator=(const Node&) = delete;
+  ~Node() {
+    if (ctx_) dora_close(ctx_);
+  }
+
+  Event next() { return Event(ctx_, dora_next_event(ctx_)); }
+
+  void send_output(const std::string& id, const unsigned char* data,
+                   size_t len, const char* encoding = "raw") {
+    if (dora_send_output_enc(ctx_, id.c_str(), data, len, encoding) != 0)
+      throw std::runtime_error(std::string("dora: send_output failed: ") +
+                               dora_last_error(ctx_));
+  }
+
+  std::string node_id() const { return dora_node_id(ctx_); }
+  std::string dataflow_id() const { return dora_dataflow_id(ctx_); }
+
+ private:
+  DoraContext* ctx_;
+};
+
+}  // namespace dora

@@ -1,0 +1,62 @@
+// dora-tpu C operator ABI.
+//
+// Reference parity: apis/c/operator/operator_types.h + the safer-ffi ABI
+// (apis/rust/operator/types/src/lib.rs:21-156): a shared library exports
+//
+//   void* dora_init_operator(void);                     // -> operator state
+//   void  dora_drop_operator(void* state);
+//   int   dora_on_event(void* state, const DoraOperatorEvent* event,
+//                       const DoraOperatorSendOutput* send_output);
+//
+// dora_on_event returns a DoraOperatorStatus. The runtime loads the
+// library with dlopen and calls these symbols (ctypes on the Python
+// side — no binding layer needed beyond this header).
+
+#ifndef DORA_TPU_OPERATOR_API_H
+#define DORA_TPU_OPERATOR_API_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  DORA_OP_CONTINUE = 0,
+  DORA_OP_STOP = 1,
+  DORA_OP_STOP_ALL = 2,
+} DoraOperatorStatus;
+
+typedef enum {
+  DORA_OP_EVENT_INPUT = 0,
+  DORA_OP_EVENT_INPUT_CLOSED = 1,
+  DORA_OP_EVENT_STOP = 2,
+} DoraOperatorEventType;
+
+typedef struct {
+  DoraOperatorEventType type;
+  const char* id;             // input id (NULL for STOP)
+  const unsigned char* data;  // payload (NULL if none)
+  size_t data_len;
+  const char* encoding;       // "raw" | "arrow-ipc"
+} DoraOperatorEvent;
+
+// Callback table handed to dora_on_event: call `send` to publish an
+// output. `context` must be passed through unchanged.
+typedef struct DoraOperatorSendOutput {
+  void* context;
+  int (*send)(void* context, const char* output_id,
+              const unsigned char* data, size_t data_len,
+              const char* encoding);
+} DoraOperatorSendOutput;
+
+typedef void* (*dora_init_operator_t)(void);
+typedef void (*dora_drop_operator_t)(void* state);
+typedef int (*dora_on_event_t)(void* state, const DoraOperatorEvent* event,
+                               const DoraOperatorSendOutput* send_output);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // DORA_TPU_OPERATOR_API_H

@@ -1,0 +1,33 @@
+// C declarations of the dora-tpu native shared-memory layer (shmem.cpp).
+#pragma once
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+void* dtp_region_create(const char* name, uint64_t size);
+void* dtp_region_open(const char* name);
+void* dtp_region_ptr(void* region);
+uint64_t dtp_region_size(void* region);
+void dtp_region_close(void* region, int unlink_it);
+int dtp_region_unlink(const char* name);
+
+void* dtp_channel_create(const char* name, uint32_t capacity);
+void* dtp_channel_open(const char* name);
+uint32_t dtp_channel_capacity(void* chan);
+int dtp_channel_send(void* chan, const uint8_t* data, uint64_t len,
+                     int is_server);
+int64_t dtp_channel_recv(void* chan, uint8_t* out, uint64_t out_cap,
+                         int64_t timeout_ms, int is_server);
+int64_t dtp_channel_recv_ptr(void* chan, const uint8_t** out,
+                             int64_t timeout_ms, int is_server);
+void dtp_channel_recv_done(void* chan, int is_server);
+void dtp_channel_disconnect(void* chan);
+int dtp_channel_is_disconnected(void* chan);
+void dtp_channel_close(void* chan, int unlink_it);
+
+#ifdef __cplusplus
+}
+#endif

@@ -1,0 +1,276 @@
+// Minimal msgpack DOM reader/writer for the dora-tpu wire protocol.
+//
+// The protocol (dora_tpu/message/serde.py) encodes messages as tagged
+// maps {"t": <type name>, "f": {<field>: <value>}} packed with msgpack.
+// This implements exactly the subset the node API needs: nil, bool,
+// int/uint, float64, str, bin, array, map.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace dtpmp {
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Kind { Nil, Bool, Int, Float, Str, Bin, Array, Map } kind = Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0;
+  std::string s;                 // Str and Bin payloads
+  std::vector<ValuePtr> arr;
+  std::map<std::string, ValuePtr> map;
+
+  bool is_nil() const { return kind == Nil; }
+  int64_t as_int() const { return kind == Float ? (int64_t)f : i; }
+  const std::string& as_str() const { return s; }
+
+  const ValuePtr field(const std::string& key) const {
+    auto it = map.find(key);
+    return it == map.end() ? nullptr : it->second;
+  }
+  // Tagged-union helpers: {"t": name, "f": {...}}
+  std::string tag() const {
+    auto t = field("t");
+    return t && t->kind == Str ? t->s : "";
+  }
+  const ValuePtr fields() const { return field("f"); }
+};
+
+// ---------------------------------------------------------------------------
+// writer
+// ---------------------------------------------------------------------------
+
+class Writer {
+ public:
+  std::string out;
+
+  void nil() { out.push_back('\xc0'); }
+  void boolean(bool v) { out.push_back(v ? '\xc3' : '\xc2'); }
+
+  void integer(int64_t v) {
+    if (v >= 0) {
+      uint64_t u = (uint64_t)v;
+      if (u < 128) {
+        out.push_back((char)u);
+      } else if (u <= UINT8_MAX) {
+        out.push_back('\xcc');
+        put_be(u, 1);
+      } else if (u <= UINT16_MAX) {
+        out.push_back('\xcd');
+        put_be(u, 2);
+      } else if (u <= UINT32_MAX) {
+        out.push_back('\xce');
+        put_be(u, 4);
+      } else {
+        out.push_back('\xcf');
+        put_be(u, 8);
+      }
+    } else {
+      if (v >= -32) {
+        out.push_back((char)(uint8_t)v);
+      } else if (v >= INT8_MIN) {
+        out.push_back('\xd0');
+        put_be((uint64_t)(uint8_t)v, 1);
+      } else if (v >= INT16_MIN) {
+        out.push_back('\xd1');
+        put_be((uint64_t)(uint16_t)v, 2);
+      } else if (v >= INT32_MIN) {
+        out.push_back('\xd2');
+        put_be((uint64_t)(uint32_t)v, 4);
+      } else {
+        out.push_back('\xd3');
+        put_be((uint64_t)v, 8);
+      }
+    }
+  }
+
+  void real(double v) {
+    out.push_back('\xcb');
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    put_be(bits, 8);
+  }
+
+  void str(const std::string& v) {
+    size_t n = v.size();
+    if (n < 32) {
+      out.push_back((char)(0xa0 | n));
+    } else if (n <= UINT8_MAX) {
+      out.push_back('\xd9');
+      put_be(n, 1);
+    } else if (n <= UINT16_MAX) {
+      out.push_back('\xda');
+      put_be(n, 2);
+    } else {
+      out.push_back('\xdb');
+      put_be(n, 4);
+    }
+    out.append(v);
+  }
+
+  void bin(const uint8_t* data, size_t n) {
+    if (n <= UINT8_MAX) {
+      out.push_back('\xc4');
+      put_be(n, 1);
+    } else if (n <= UINT16_MAX) {
+      out.push_back('\xc5');
+      put_be(n, 2);
+    } else {
+      out.push_back('\xc6');
+      put_be(n, 4);
+    }
+    out.append(reinterpret_cast<const char*>(data), n);
+  }
+
+  void array_header(size_t n) {
+    if (n < 16) {
+      out.push_back((char)(0x90 | n));
+    } else if (n <= UINT16_MAX) {
+      out.push_back('\xdc');
+      put_be(n, 2);
+    } else {
+      out.push_back('\xdd');
+      put_be(n, 4);
+    }
+  }
+
+  void map_header(size_t n) {
+    if (n < 16) {
+      out.push_back((char)(0x80 | n));
+    } else if (n <= UINT16_MAX) {
+      out.push_back('\xde');
+      put_be(n, 2);
+    } else {
+      out.push_back('\xdf');
+      put_be(n, 4);
+    }
+  }
+
+ private:
+  void put_be(uint64_t v, int bytes) {
+    for (int i = bytes - 1; i >= 0; --i)
+      out.push_back((char)((v >> (8 * i)) & 0xff));
+  }
+};
+
+// ---------------------------------------------------------------------------
+// reader
+// ---------------------------------------------------------------------------
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+
+  ValuePtr parse() {
+    if (p_ >= end_) throw std::runtime_error("msgpack: truncated");
+    uint8_t c = *p_++;
+    auto v = std::make_shared<Value>();
+    if (c < 0x80) {  // positive fixint
+      v->kind = Value::Int;
+      v->i = c;
+    } else if (c >= 0xe0) {  // negative fixint
+      v->kind = Value::Int;
+      v->i = (int8_t)c;
+    } else if ((c & 0xf0) == 0x80) {
+      read_map(*v, c & 0x0f);
+    } else if ((c & 0xf0) == 0x90) {
+      read_array(*v, c & 0x0f);
+    } else if ((c & 0xe0) == 0xa0) {
+      read_str(*v, c & 0x1f);
+    } else {
+      switch (c) {
+        case 0xc0: v->kind = Value::Nil; break;
+        case 0xc2: v->kind = Value::Bool; v->b = false; break;
+        case 0xc3: v->kind = Value::Bool; v->b = true; break;
+        case 0xc4: read_bin(*v, take_be(1)); break;
+        case 0xc5: read_bin(*v, take_be(2)); break;
+        case 0xc6: read_bin(*v, take_be(4)); break;
+        case 0xca: {  // float32
+          uint32_t bits = (uint32_t)take_be(4);
+          float f;
+          memcpy(&f, &bits, 4);
+          v->kind = Value::Float;
+          v->f = f;
+          break;
+        }
+        case 0xcb: {  // float64
+          uint64_t bits = take_be(8);
+          memcpy(&v->f, &bits, 8);
+          v->kind = Value::Float;
+          break;
+        }
+        case 0xcc: v->kind = Value::Int; v->i = (int64_t)take_be(1); break;
+        case 0xcd: v->kind = Value::Int; v->i = (int64_t)take_be(2); break;
+        case 0xce: v->kind = Value::Int; v->i = (int64_t)take_be(4); break;
+        case 0xcf: v->kind = Value::Int; v->i = (int64_t)take_be(8); break;
+        case 0xd0: v->kind = Value::Int; v->i = (int8_t)take_be(1); break;
+        case 0xd1: v->kind = Value::Int; v->i = (int16_t)take_be(2); break;
+        case 0xd2: v->kind = Value::Int; v->i = (int32_t)take_be(4); break;
+        case 0xd3: v->kind = Value::Int; v->i = (int64_t)take_be(8); break;
+        case 0xd9: read_str(*v, take_be(1)); break;
+        case 0xda: read_str(*v, take_be(2)); break;
+        case 0xdb: read_str(*v, take_be(4)); break;
+        case 0xdc: read_array(*v, take_be(2)); break;
+        case 0xdd: read_array(*v, take_be(4)); break;
+        case 0xde: read_map(*v, take_be(2)); break;
+        case 0xdf: read_map(*v, take_be(4)); break;
+        default:
+          throw std::runtime_error("msgpack: unsupported type byte");
+      }
+    }
+    return v;
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+
+  uint64_t take_be(int bytes) {
+    if (p_ + bytes > end_) throw std::runtime_error("msgpack: truncated");
+    uint64_t v = 0;
+    for (int i = 0; i < bytes; ++i) v = (v << 8) | *p_++;
+    return v;
+  }
+
+  void take_raw(std::string& out, size_t n) {
+    if (p_ + n > end_) throw std::runtime_error("msgpack: truncated");
+    out.assign(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+  }
+
+  void read_str(Value& v, size_t n) {
+    v.kind = Value::Str;
+    take_raw(v.s, n);
+  }
+
+  void read_bin(Value& v, size_t n) {
+    v.kind = Value::Bin;
+    take_raw(v.s, n);
+  }
+
+  void read_array(Value& v, size_t n) {
+    v.kind = Value::Array;
+    v.arr.reserve(n);
+    for (size_t i = 0; i < n; ++i) v.arr.push_back(parse());
+  }
+
+  void read_map(Value& v, size_t n) {
+    v.kind = Value::Map;
+    for (size_t i = 0; i < n; ++i) {
+      auto key = parse();
+      auto val = parse();
+      if (key->kind == Value::Str) v.map[key->s] = val;
+    }
+  }
+};
+
+}  // namespace dtpmp

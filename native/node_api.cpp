@@ -1,0 +1,778 @@
+// dora-tpu C node API implementation.
+//
+// Reference parity: apis/rust/node + apis/c/node — speaks the full node
+// protocol: Register on three channels (control/events/drop), the
+// cluster-wide Subscribe start barrier, blocking NextEvent with
+// piggybacked drop-token acks, SendMessage with shared-memory regions for
+// payloads >= 4 KiB (region cache recycled by a drop-stream thread), and
+// OutputsDone on close.
+//
+// Build (with shmem.cpp): see dora_tpu/native.py build_node_api().
+
+#include "dora_node_api.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <functional>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dtp_shmem.h"
+#include "msgpack.hpp"
+
+namespace {
+
+constexpr const char* kProtocolVersion = "0.1.0";
+constexpr size_t kZeroCopyThreshold = 4096;
+constexpr size_t kMaxCachedRegions = 20;
+
+using dtpmp::Reader;
+using dtpmp::Value;
+using dtpmp::ValuePtr;
+using dtpmp::Writer;
+
+// ---------------------------------------------------------------------------
+// small utilities
+// ---------------------------------------------------------------------------
+
+std::string random_hex(size_t n) {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  static const char* digits = "0123456789abcdef";
+  std::string out;
+  out.reserve(n);
+  for (size_t i = 0; i < n; ++i) out.push_back(digits[rng() & 0xf]);
+  return out;
+}
+
+int64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+std::string base64_decode(const std::string& in) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    int v = val(c);
+    if (v < 0) continue;
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back((char)((buf >> bits) & 0xff));
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// channels (client side)
+// ---------------------------------------------------------------------------
+
+struct Channel {
+  virtual ~Channel() = default;
+  virtual bool send(const std::string& frame) = 0;
+  virtual bool recv(std::string& frame) = 0;  // blocking
+  virtual void interrupt() {}
+};
+
+struct SocketChannel : Channel {
+  int fd = -1;
+  ~SocketChannel() override {
+    if (fd >= 0) close(fd);
+  }
+  bool send(const std::string& frame) override {
+    uint32_t len = (uint32_t)frame.size();
+    char header[4] = {(char)(len & 0xff), (char)((len >> 8) & 0xff),
+                      (char)((len >> 16) & 0xff), (char)((len >> 24) & 0xff)};
+    return write_all(header, 4) && write_all(frame.data(), frame.size());
+  }
+  bool recv(std::string& frame) override {
+    unsigned char header[4];
+    if (!read_all(header, 4)) return false;
+    uint32_t len = header[0] | (header[1] << 8) | (header[2] << 16) |
+                   ((uint32_t)header[3] << 24);
+    frame.resize(len);
+    return len == 0 || read_all(&frame[0], len);
+  }
+  void interrupt() override {
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+  }
+  bool write_all(const void* data, size_t n) {
+    const char* p = (const char*)data;
+    while (n) {
+      ssize_t k = ::write(fd, p, n);
+      if (k <= 0) return false;
+      p += k;
+      n -= (size_t)k;
+    }
+    return true;
+  }
+  bool read_all(void* data, size_t n) {
+    char* p = (char*)data;
+    while (n) {
+      ssize_t k = ::read(fd, p, n);
+      if (k <= 0) return false;
+      p += k;
+      n -= (size_t)k;
+    }
+    return true;
+  }
+};
+
+SocketChannel* connect_tcp(const std::string& addr) {
+  auto colon = addr.rfind(':');
+  if (colon == std::string::npos) return nullptr;
+  std::string host = addr.substr(0, colon);
+  int port = atoi(addr.c_str() + colon + 1);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1 ||
+      connect(fd, (struct sockaddr*)&sa, sizeof(sa)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* ch = new SocketChannel();
+  ch->fd = fd;
+  return ch;
+}
+
+SocketChannel* connect_uds(const std::string& path) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  struct sockaddr_un sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sun_family = AF_UNIX;
+  strncpy(sa.sun_path, path.c_str(), sizeof(sa.sun_path) - 1);
+  if (connect(fd, (struct sockaddr*)&sa, sizeof(sa)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  auto* ch = new SocketChannel();
+  ch->fd = fd;
+  return ch;
+}
+
+struct ShmemClientChannel : Channel {
+  void* chan = nullptr;
+  ~ShmemClientChannel() override {
+    if (chan) dtp_channel_close(chan, 0);
+  }
+  bool send(const std::string& frame) override {
+    return dtp_channel_send(chan, (const uint8_t*)frame.data(), frame.size(),
+                            /*is_server=*/0) == 0;
+  }
+  bool recv(std::string& frame) override {
+    const uint8_t* ptr = nullptr;
+    int64_t n = dtp_channel_recv_ptr(chan, &ptr, /*timeout_ms=*/-1,
+                                     /*is_server=*/0);
+    if (n < 0) return false;
+    frame.assign((const char*)ptr, (size_t)n);
+    dtp_channel_recv_done(chan, /*is_server=*/0);  // release the slot
+    return true;
+  }
+  void interrupt() override {
+    if (chan) dtp_channel_disconnect(chan);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// protocol encoding
+// ---------------------------------------------------------------------------
+
+void write_timestamp(Writer& w, const std::string& clock_id) {
+  w.map_header(2);
+  w.str("t");
+  w.str("@ts");
+  w.str("f");
+  w.array_header(3);
+  w.integer(now_ns());
+  w.integer(0);
+  w.str(clock_id);
+}
+
+// Wraps `write_inner` output into a Timestamped envelope.
+std::string envelope(const std::string& clock_id,
+                     const std::function<void(Writer&)>& write_inner) {
+  Writer w;
+  w.map_header(2);
+  w.str("t");
+  w.str("Timestamped");
+  w.str("f");
+  w.map_header(2);
+  w.str("inner");
+  write_inner(w);
+  w.str("timestamp");
+  write_timestamp(w, clock_id);
+  return std::move(w.out);
+}
+
+void write_tagged_header(Writer& w, const char* type, size_t n_fields) {
+  w.map_header(2);
+  w.str("t");
+  w.str(type);
+  w.str("f");
+  w.map_header(n_fields);
+}
+
+// ---------------------------------------------------------------------------
+// context / event structs
+// ---------------------------------------------------------------------------
+
+struct MappedRegion {
+  void* handle = nullptr;
+  const uint8_t* ptr = nullptr;
+  uint64_t size = 0;
+};
+
+struct OwnedRegion {
+  void* handle = nullptr;
+  uint8_t* ptr = nullptr;
+  uint64_t size = 0;
+  std::string name;
+};
+
+}  // namespace
+
+struct DoraEvent {
+  DoraEventType type = DORA_EVENT_STOP;
+  std::string id;
+  std::string encoding;
+  std::string inline_data;        // owned payload (inline case)
+  const uint8_t* data = nullptr;  // view (inline or mapped region)
+  size_t len = 0;
+  std::string drop_token;  // ack on free (shmem case)
+};
+
+struct DoraContext {
+  std::string dataflow_id;
+  std::string node_id;
+  std::string clock_id;
+  std::vector<std::string> outputs;
+  std::unique_ptr<Channel> control;
+  std::unique_ptr<Channel> events;
+  std::unique_ptr<Channel> drops;
+  std::deque<DoraEvent*> queued;
+  bool stream_closed = false;
+  std::string last_error;
+
+  // receive side: mapped regions stay mapped for the node's lifetime
+  std::map<std::string, MappedRegion> mapped;
+  std::vector<std::string> pending_acks;
+  std::mutex ack_mutex;
+
+  // send side: our regions, recycled when receivers release them
+  std::mutex region_mutex;
+  std::map<std::string, OwnedRegion> regions_in_use;  // token -> region
+  std::vector<OwnedRegion> regions_free;
+  std::thread drop_thread;
+  std::atomic<bool> closing{false};
+
+  bool request(Channel& ch, const std::string& frame, ValuePtr* reply) {
+    if (!ch.send(frame)) {
+      last_error = "channel send failed";
+      return false;
+    }
+    if (!reply) return true;
+    std::string raw;
+    if (!ch.recv(raw)) {
+      last_error = "channel recv failed";
+      return false;
+    }
+    try {
+      Reader reader((const uint8_t*)raw.data(), raw.size());
+      auto envelope = reader.parse();
+      auto fields = envelope->fields();
+      *reply = fields ? fields->field("inner") : nullptr;
+      if (!*reply) {
+        last_error = "malformed reply";
+        return false;
+      }
+    } catch (const std::exception& e) {
+      last_error = e.what();
+      return false;
+    }
+    return true;
+  }
+};
+
+namespace {
+
+bool check_result(DoraContext* ctx, const ValuePtr& reply) {
+  if (!reply) return false;
+  if (reply->tag() != "ReplyResult") {
+    ctx->last_error = "unexpected reply " + reply->tag();
+    return false;
+  }
+  auto err = reply->fields() ? reply->fields()->field("error") : nullptr;
+  if (err && err->kind == Value::Str && !err->s.empty()) {
+    ctx->last_error = err->s;
+    return false;
+  }
+  return true;
+}
+
+std::string register_frame(DoraContext* ctx, const char* channel) {
+  return envelope(ctx->clock_id, [&](Writer& w) {
+    write_tagged_header(w, "Register", 4);
+    w.str("dataflow_id");
+    w.str(ctx->dataflow_id);
+    w.str("node_id");
+    w.str(ctx->node_id);
+    w.str("protocol_version");
+    w.str(kProtocolVersion);
+    w.str("channel");
+    w.str(channel);
+  });
+}
+
+Channel* open_channel(const ValuePtr& comm, const char* kind,
+                      std::string* error) {
+  std::string tag = comm->tag();
+  auto fields = comm->fields();
+  if (tag == "TcpCommunication") {
+    auto* ch = connect_tcp(fields->field("socket_addr")->as_str());
+    if (!ch) *error = "tcp connect failed";
+    return ch;
+  }
+  if (tag == "UnixDomainCommunication") {
+    auto* ch = connect_uds(fields->field("socket_file")->as_str());
+    if (!ch) *error = "uds connect failed";
+    return ch;
+  }
+  if (tag == "ShmemCommunication") {
+    const char* field = strcmp(kind, "control") == 0 ? "control_region_id"
+                        : strcmp(kind, "events") == 0 ? "events_region_id"
+                                                      : "drop_region_id";
+    void* chan = dtp_channel_open(fields->field(field)->as_str().c_str());
+    if (!chan) {
+      *error = "shmem channel open failed";
+      return nullptr;
+    }
+    auto* ch = new ShmemClientChannel();
+    ch->chan = chan;
+    return ch;
+  }
+  *error = "unknown daemon communication " + tag;
+  return nullptr;
+}
+
+void drop_thread_main(DoraContext* ctx) {
+  while (!ctx->closing.load()) {
+    auto frame = envelope(ctx->clock_id, [&](Writer& w) {
+      write_tagged_header(w, "NextDropEvents", 0);
+    });
+    ValuePtr reply;
+    if (!ctx->request(*ctx->drops, frame, &reply)) return;
+    if (!reply || reply->tag() != "DropEvents") return;
+    auto tokens = reply->fields()->field("drop_tokens");
+    if (!tokens || tokens->arr.empty()) return;  // stream closed
+    std::lock_guard<std::mutex> lock(ctx->region_mutex);
+    for (auto& tok : tokens->arr) {
+      auto it = ctx->regions_in_use.find(tok->as_str());
+      if (it == ctx->regions_in_use.end()) continue;
+      if (ctx->regions_free.size() < kMaxCachedRegions) {
+        ctx->regions_free.push_back(it->second);
+      } else {
+        dtp_region_close(it->second.handle, /*unlink=*/1);
+      }
+      ctx->regions_in_use.erase(it);
+    }
+  }
+}
+
+DoraEvent* convert_event(DoraContext* ctx, const ValuePtr& inner) {
+  std::string tag = inner->tag();
+  auto* event = new DoraEvent();
+  if (tag == "Stop") {
+    event->type = DORA_EVENT_STOP;
+    return event;
+  }
+  if (tag == "Reload") {
+    event->type = DORA_EVENT_RELOAD;
+    return event;
+  }
+  if (tag == "InputClosed") {
+    event->type = DORA_EVENT_INPUT_CLOSED;
+    event->id = inner->fields()->field("id")->as_str();
+    return event;
+  }
+  if (tag == "AllInputsClosed") {
+    delete event;
+    ctx->stream_closed = true;
+    return nullptr;
+  }
+  if (tag != "Input") {
+    delete event;
+    return nullptr;
+  }
+  event->type = DORA_EVENT_INPUT;
+  auto fields = inner->fields();
+  event->id = fields->field("id")->as_str();
+  auto metadata = fields->field("metadata");
+  if (metadata && metadata->fields()) {
+    auto type_info = metadata->fields()->field("type_info");
+    if (type_info && type_info->fields()) {
+      auto enc = type_info->fields()->field("encoding");
+      if (enc) event->encoding = enc->as_str();
+    }
+  }
+  auto data = fields->field("data");
+  if (!data || data->is_nil()) return event;
+  if (data->tag() == "InlineData") {
+    event->inline_data = data->fields()->field("data")->s;
+    event->data = (const uint8_t*)event->inline_data.data();
+    event->len = event->inline_data.size();
+    return event;
+  }
+  if (data->tag() == "SharedMemoryData") {
+    auto f = data->fields();
+    std::string shmem_id = f->field("shmem_id")->as_str();
+    uint64_t len = (uint64_t)f->field("len")->as_int();
+    event->drop_token = f->field("drop_token")->as_str();
+    auto it = ctx->mapped.find(shmem_id);
+    if (it == ctx->mapped.end()) {
+      void* handle = dtp_region_open(shmem_id.c_str());
+      if (!handle) {
+        ctx->last_error = "cannot map region " + shmem_id;
+        event->type = DORA_EVENT_ERROR;
+        return event;
+      }
+      MappedRegion m{handle, (const uint8_t*)dtp_region_ptr(handle),
+                     dtp_region_size(handle)};
+      it = ctx->mapped.emplace(shmem_id, m).first;
+    }
+    event->data = it->second.ptr;
+    event->len = (size_t)len;
+    return event;
+  }
+  return event;
+}
+
+bool pump_events(DoraContext* ctx) {
+  std::vector<std::string> acks;
+  {
+    std::lock_guard<std::mutex> lock(ctx->ack_mutex);
+    acks.swap(ctx->pending_acks);
+  }
+  auto frame = envelope(ctx->clock_id, [&](Writer& w) {
+    write_tagged_header(w, "NextEvent", 1);
+    w.str("drop_tokens");
+    w.array_header(acks.size());
+    for (auto& a : acks) w.str(a);
+  });
+  ValuePtr reply;
+  if (!ctx->request(*ctx->events, frame, &reply)) return false;
+  if (!reply || reply->tag() != "NextEvents") {
+    ctx->last_error = "unexpected events reply";
+    return false;
+  }
+  auto events = reply->fields()->field("events");
+  if (!events || events->arr.empty()) return false;  // stream end
+  for (auto& ts : events->arr) {
+    auto fields = ts->fields();
+    if (!fields) continue;
+    auto inner = fields->field("inner");
+    if (!inner) continue;
+    auto* event = convert_event(ctx, inner);
+    if (event) ctx->queued.push_back(event);
+  }
+  return true;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// public API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+DoraContext* dora_init_from_env(void) {
+  const char* raw = getenv("DORA_NODE_CONFIG");
+  if (!raw) {
+    fprintf(stderr, "dora: DORA_NODE_CONFIG is not set\n");
+    return nullptr;
+  }
+  std::string packed = base64_decode(raw);
+  auto ctx = std::make_unique<DoraContext>();
+  ValuePtr comm;
+  try {
+    Reader reader((const uint8_t*)packed.data(), packed.size());
+    auto config = reader.parse();
+    auto fields = config->fields();
+    ctx->dataflow_id = fields->field("dataflow_id")->as_str();
+    ctx->node_id = fields->field("node_id")->as_str();
+    comm = fields->field("daemon_communication");
+    auto run_config = fields->field("run_config");
+    if (run_config && run_config->fields()) {
+      auto outs = run_config->fields()->field("outputs");
+      if (outs)
+        for (auto& o : outs->arr) ctx->outputs.push_back(o->as_str());
+    }
+  } catch (const std::exception& e) {
+    fprintf(stderr, "dora: bad DORA_NODE_CONFIG: %s\n", e.what());
+    return nullptr;
+  }
+  ctx->clock_id = random_hex(32);
+
+  struct {
+    const char* kind;
+    std::unique_ptr<Channel>* slot;
+  } channels[] = {{"control", &ctx->control},
+                  {"drop", &ctx->drops},
+                  {"events", &ctx->events}};
+  for (auto& entry : channels) {
+    std::string error;
+    Channel* ch = open_channel(comm, entry.kind, &error);
+    if (!ch) {
+      fprintf(stderr, "dora: %s\n", error.c_str());
+      return nullptr;
+    }
+    entry.slot->reset(ch);
+    ValuePtr reply;
+    if (!ctx->request(*ch, register_frame(ctx.get(), entry.kind), &reply) ||
+        !check_result(ctx.get(), reply)) {
+      fprintf(stderr, "dora: register(%s) failed: %s\n", entry.kind,
+              ctx->last_error.c_str());
+      return nullptr;
+    }
+  }
+
+  // Drop stream first (region recycling), then the blocking Subscribe
+  // (start barrier).
+  ValuePtr reply;
+  auto sub_drop = envelope(ctx->clock_id, [&](Writer& w) {
+    write_tagged_header(w, "SubscribeDrop", 0);
+  });
+  if (!ctx->request(*ctx->drops, sub_drop, &reply) ||
+      !check_result(ctx.get(), reply))
+    return nullptr;
+  ctx->drop_thread = std::thread(drop_thread_main, ctx.get());
+
+  auto subscribe = envelope(ctx->clock_id, [&](Writer& w) {
+    write_tagged_header(w, "Subscribe", 0);
+  });
+  if (!ctx->request(*ctx->events, subscribe, &reply) ||
+      !check_result(ctx.get(), reply)) {
+    fprintf(stderr, "dora: subscribe failed: %s\n", ctx->last_error.c_str());
+    ctx->closing = true;
+    ctx->drops->interrupt();
+    if (ctx->drop_thread.joinable()) ctx->drop_thread.join();
+    return nullptr;
+  }
+  return ctx.release();
+}
+
+const char* dora_node_id(const DoraContext* ctx) {
+  return ctx->node_id.c_str();
+}
+
+const char* dora_dataflow_id(const DoraContext* ctx) {
+  return ctx->dataflow_id.c_str();
+}
+
+const char* dora_last_error(DoraContext* ctx) {
+  return ctx->last_error.c_str();
+}
+
+DoraEvent* dora_next_event(DoraContext* ctx) {
+  while (ctx->queued.empty()) {
+    if (ctx->stream_closed) return nullptr;
+    if (!pump_events(ctx)) return nullptr;
+  }
+  auto* event = ctx->queued.front();
+  ctx->queued.pop_front();
+  return event;
+}
+
+DoraEventType dora_event_type(const DoraEvent* event) { return event->type; }
+
+const char* dora_event_id(const DoraEvent* event) {
+  return event->id.empty() ? nullptr : event->id.c_str();
+}
+
+const char* dora_event_encoding(const DoraEvent* event) {
+  return event->encoding.empty() ? "raw" : event->encoding.c_str();
+}
+
+const unsigned char* dora_event_data(const DoraEvent* event, size_t* len) {
+  if (len) *len = event->len;
+  return event->data;
+}
+
+void dora_event_free(DoraContext* ctx, DoraEvent* event) {
+  if (!event) return;
+  if (!event->drop_token.empty()) {
+    std::lock_guard<std::mutex> lock(ctx->ack_mutex);
+    ctx->pending_acks.push_back(event->drop_token);
+  }
+  delete event;
+}
+
+int dora_send_output_enc(DoraContext* ctx, const char* output_id,
+                         const unsigned char* data, size_t len,
+                         const char* encoding) {
+  // Stage the payload first: shmem region for large data (recycled from
+  // the cache when possible), inline bytes otherwise.
+  bool use_region = len >= kZeroCopyThreshold;
+  OwnedRegion region;
+  std::string token;
+  if (use_region) {
+    {
+      std::lock_guard<std::mutex> lock(ctx->region_mutex);
+      for (size_t i = 0; i < ctx->regions_free.size(); ++i) {
+        if (ctx->regions_free[i].size >= len) {
+          region = ctx->regions_free[i];
+          ctx->regions_free.erase(ctx->regions_free.begin() + i);
+          break;
+        }
+      }
+    }
+    if (!region.handle) {
+      uint64_t size = 4096;
+      while (size < len) size <<= 1;
+      region.name = "dtpc-" + random_hex(16);
+      region.handle = dtp_region_create(region.name.c_str(), size);
+      if (!region.handle) {
+        ctx->last_error = "region create failed";
+        return 1;
+      }
+      region.ptr = (uint8_t*)dtp_region_ptr(region.handle);
+      region.size = dtp_region_size(region.handle);
+    }
+    memcpy(region.ptr, data, len);
+    token = random_hex(32);
+    std::lock_guard<std::mutex> lock(ctx->region_mutex);
+    ctx->regions_in_use[token] = region;
+  }
+
+  std::string frame = envelope(ctx->clock_id, [&](Writer& w) {
+    write_tagged_header(w, "SendMessage", 3);
+    w.str("output_id");
+    w.str(output_id);
+    w.str("metadata");
+    write_tagged_header(w, "Metadata", 2);
+    w.str("type_info");
+    write_tagged_header(w, "TypeInfo", 2);
+    w.str("encoding");
+    w.str(encoding);
+    w.str("len");
+    w.integer((int64_t)len);
+    w.str("parameters");
+    w.map_header(0);
+    w.str("data");
+    if (len == 0) {
+      w.nil();
+    } else if (!use_region) {
+      write_tagged_header(w, "InlineData", 1);
+      w.str("data");
+      w.bin(data, len);
+    } else {
+      write_tagged_header(w, "SharedMemoryData", 3);
+      w.str("shmem_id");
+      w.str(region.name);
+      w.str("len");
+      w.integer((int64_t)len);
+      w.str("drop_token");
+      w.str(token);
+    }
+  });
+  // SendMessage expects no reply (reference: node_to_daemon.rs:36-51).
+  if (!ctx->control->send(frame)) {
+    ctx->last_error = "send failed";
+    return 1;
+  }
+  return 0;
+}
+
+int dora_send_output(DoraContext* ctx, const char* output_id,
+                     const unsigned char* data, size_t len) {
+  return dora_send_output_enc(ctx, output_id, data, len, "raw");
+}
+
+void dora_close(DoraContext* ctx) {
+  if (!ctx) return;
+  // Flush outstanding receive-side acks.
+  std::vector<std::string> acks;
+  {
+    std::lock_guard<std::mutex> lock(ctx->ack_mutex);
+    acks.swap(ctx->pending_acks);
+  }
+  if (!acks.empty()) {
+    auto frame = envelope(ctx->clock_id, [&](Writer& w) {
+      write_tagged_header(w, "ReportDropTokens", 1);
+      w.str("drop_tokens");
+      w.array_header(acks.size());
+      for (auto& a : acks) w.str(a);
+    });
+    ctx->control->send(frame);
+  }
+  ValuePtr reply;
+  auto done = envelope(ctx->clock_id, [&](Writer& w) {
+    write_tagged_header(w, "OutputsDone", 0);
+  });
+  ctx->request(*ctx->control, done, &reply);
+
+  // Wait briefly for receivers to release our regions, then tear down.
+  for (int i = 0; i < 100; ++i) {
+    {
+      std::lock_guard<std::mutex> lock(ctx->region_mutex);
+      if (ctx->regions_in_use.empty()) break;
+    }
+    struct timespec ts = {0, 100 * 1000 * 1000};
+    nanosleep(&ts, nullptr);
+  }
+  ctx->closing = true;
+  ctx->drops->interrupt();
+  if (ctx->drop_thread.joinable()) ctx->drop_thread.join();
+  ctx->events->interrupt();
+  ctx->control->interrupt();
+  {
+    std::lock_guard<std::mutex> lock(ctx->region_mutex);
+    for (auto& entry : ctx->regions_in_use)
+      dtp_region_close(entry.second.handle, 1);
+    for (auto& region : ctx->regions_free)
+      dtp_region_close(region.handle, 1);
+  }
+  for (auto& entry : ctx->mapped) dtp_region_close(entry.second.handle, 0);
+  while (!ctx->queued.empty()) {
+    delete ctx->queued.front();
+    ctx->queued.pop_front();
+  }
+  delete ctx;
+}
+
+}  // extern "C"

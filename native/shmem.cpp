@@ -79,13 +79,23 @@ struct Event {
 // Channel header layout (inside the shared region)
 // ---------------------------------------------------------------------------
 
-constexpr uint32_t kMagic = 0xD02A79C1;
+constexpr uint32_t kMagic = 0xD02A79C2;
 
 struct ChannelHeader {
   uint32_t magic;
   uint32_t capacity;                  // payload area size
   Event server_event;                 // signaled when a request is ready
   Event client_event;                 // signaled when a reply is ready
+  // Flow control: one pending flag + consumed signal per direction, so
+  // back-to-back fire-and-forget sends (no reply expected, e.g.
+  // SendMessage bursts) block until the receiver drained the slot instead
+  // of silently overwriting it. The payload area itself stays shared,
+  // which is safe under the single-requester discipline: replies only
+  // exist for the request currently being awaited.
+  Event c2s_free;                     // client->server slot consumed
+  Event s2c_free;                     // server->client slot consumed
+  std::atomic<uint32_t> c2s_pending;
+  std::atomic<uint32_t> s2c_pending;
   std::atomic<uint32_t> disconnected; // either side sets on close
   std::atomic<uint64_t> len;          // payload length of the pending message
   // payload follows, 64-byte aligned
@@ -196,14 +206,25 @@ uint32_t dtp_channel_capacity(void* chan) {
 }
 
 // Write a message and signal the peer. is_server: 1 when the daemon side
-// sends (signals client_event), 0 when the node side sends.
+// sends (signals client_event), 0 when the node side sends. Blocks until
+// the peer consumed any previous message in this direction.
 // Returns 0 ok, -2 disconnected, -3 message too large.
 int dtp_channel_send(void* chan, const uint8_t* data, uint64_t len,
                      int is_server) {
   Region* r = static_cast<Region*>(chan);
   auto* h = static_cast<ChannelHeader*>(r->ptr);
-  if (h->disconnected.load(std::memory_order_acquire)) return -2;
   if (len > h->capacity) return -3;
+  auto& pending = is_server ? h->s2c_pending : h->c2s_pending;
+  auto& free_ev = is_server ? h->s2c_free : h->c2s_free;
+  for (;;) {
+    if (h->disconnected.load(std::memory_order_acquire)) return -2;
+    uint32_t expected = 0;
+    if (pending.compare_exchange_strong(expected, 1,
+                                        std::memory_order_acq_rel)) {
+      break;
+    }
+    free_ev.wait(100);  // slice so disconnects are noticed
+  }
   memcpy(static_cast<uint8_t*>(r->ptr) + kPayloadOffset, data, len);
   h->len.store(len, std::memory_order_release);
   (is_server ? h->client_event : h->server_event).set();
@@ -239,6 +260,11 @@ int64_t dtp_channel_recv(void* chan, uint8_t* out, uint64_t out_cap,
     return -4;
   }
   memcpy(out, static_cast<uint8_t*>(r->ptr) + kPayloadOffset, len);
+  // Release the sender's slot (the incoming direction from our view).
+  auto& pending = is_server ? h->c2s_pending : h->s2c_pending;
+  auto& free_ev = is_server ? h->c2s_free : h->s2c_free;
+  pending.store(0, std::memory_order_release);
+  free_ev.set();
   return (int64_t)len;
 }
 
@@ -261,8 +287,21 @@ int64_t dtp_channel_recv_ptr(void* chan, const uint8_t** out,
       if (timeout_ms <= 0) return -1;
     }
   }
+  // NOTE: the sender's slot is NOT released here — the caller still reads
+  // the payload in place. It is released by dtp_channel_recv_done (or the
+  // next copying recv).
   *out = static_cast<uint8_t*>(r->ptr) + kPayloadOffset;
   return (int64_t)h->len.load(std::memory_order_acquire);
+}
+
+// Release the in-place payload obtained from dtp_channel_recv_ptr.
+void dtp_channel_recv_done(void* chan, int is_server) {
+  Region* r = static_cast<Region*>(chan);
+  auto* h = static_cast<ChannelHeader*>(r->ptr);
+  auto& pending = is_server ? h->c2s_pending : h->s2c_pending;
+  auto& free_ev = is_server ? h->c2s_free : h->s2c_free;
+  pending.store(0, std::memory_order_release);
+  free_ev.set();
 }
 
 // Mark disconnected and wake any waiter on both sides (reference: disconnect
@@ -273,6 +312,8 @@ void dtp_channel_disconnect(void* chan) {
   h->disconnected.store(1, std::memory_order_release);
   futex(&h->server_event.word, FUTEX_WAKE, INT32_MAX, nullptr);
   futex(&h->client_event.word, FUTEX_WAKE, INT32_MAX, nullptr);
+  futex(&h->c2s_free.word, FUTEX_WAKE, INT32_MAX, nullptr);
+  futex(&h->s2c_free.word, FUTEX_WAKE, INT32_MAX, nullptr);
 }
 
 int dtp_channel_is_disconnected(void* chan) {

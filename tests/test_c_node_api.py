@@ -1,0 +1,204 @@
+"""C/C++ node API end-to-end: compile real C/C++ nodes and run them in a
+dataflow next to Python nodes.
+
+Reference parity: examples/c-dataflow and c++-dataflow (SURVEY.md §2.5) —
+the CI-level proof that non-Python nodes speak the full protocol
+(register, barrier, events, zero-copy shmem payloads, drop tokens).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+import yaml
+
+from dora_tpu.daemon import run_dataflow
+
+NATIVE = Path(__file__).resolve().parent.parent / "native"
+
+
+def compile_node(tmp_path: Path, name: str, source: str, cpp: bool = False) -> Path:
+    src = tmp_path / f"{name}.{'cpp' if cpp else 'c'}"
+    src.write_text(textwrap.dedent(source))
+    out = tmp_path / name
+    cmd = [
+        "g++", "-O1", "-std=c++17", "-I", str(NATIVE),
+        str(src), str(NATIVE / "node_api.cpp"), str(NATIVE / "shmem.cpp"),
+        "-o", str(out), "-lrt", "-pthread",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise AssertionError(f"compile failed:\n{proc.stderr}")
+    return out
+
+
+C_RELAY = """
+    #include <stdio.h>
+    #include <string.h>
+    #include "dora_node_api.h"
+
+    int main(void) {
+      DoraContext* ctx = dora_init_from_env();
+      if (!ctx) return 1;
+      int received = 0;
+      DoraEvent* event;
+      while ((event = dora_next_event(ctx)) != NULL) {
+        DoraEventType type = dora_event_type(event);
+        if (type == DORA_EVENT_STOP) {
+          dora_event_free(ctx, event);
+          break;
+        }
+        if (type == DORA_EVENT_INPUT) {
+          size_t len;
+          const unsigned char* data = dora_event_data(event, &len);
+          received++;
+          /* echo the payload back out, preserving the encoding */
+          if (dora_send_output_enc(ctx, "echo", data, len,
+                                   dora_event_encoding(event)) != 0) {
+            fprintf(stderr, "send failed: %s\\n", dora_last_error(ctx));
+            dora_event_free(ctx, event);
+            dora_close(ctx);
+            return 1;
+          }
+        }
+        dora_event_free(ctx, event);
+      }
+      fprintf(stderr, "c node relayed %d inputs\\n", received);
+      dora_close(ctx);
+      return received > 0 ? 0 : 1;
+    }
+"""
+
+
+@pytest.mark.parametrize("comm", ["tcp", "shmem"])
+def test_c_relay_roundtrip(tmp_path, comm):
+    """python sender -> C relay -> python assert, inline payloads."""
+    node = compile_node(tmp_path, "c_relay", C_RELAY)
+    spec = {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[1, 2, 3]", "COUNT": "2"},
+            },
+            {
+                "id": "relay",
+                "path": str(node),
+                "inputs": {"in": "sender/data"},
+                "outputs": ["echo"],
+            },
+            {
+                "id": "receiver",
+                "path": "module:dora_tpu.nodehub.pyarrow_assert",
+                "inputs": {"in": "relay/echo"},
+                "env": {"DATA": "[1, 2, 3]", "MIN_COUNT": "2"},
+            },
+        ],
+        "communication": {"local": comm},
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(df, local_comm=comm, timeout_s=120)
+    assert result.is_ok(), result.errors()
+
+
+def test_c_node_large_payload_shmem(tmp_path):
+    """C relay with a >4 KiB payload: receives zero-copy from a region and
+    sends back through its own region (drop-token lifecycle both ways)."""
+    node = compile_node(tmp_path, "c_relay2", C_RELAY)
+    checker = tmp_path / "checker.py"
+    checker.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        node = Node()
+        seen = 0
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            data = bytes(event["value"])
+            assert len(data) == 100_000, len(data)
+            assert data == bytes(range(256)) * 390 + bytes(160), "corrupt"
+            seen += 1
+        node.close()
+        assert seen == 3, seen
+        print("large payloads ok")
+    """))
+    sender = tmp_path / "big_sender.py"
+    sender.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        payload = bytes(range(256)) * 390 + bytes(160)
+        assert len(payload) == 100_000
+        with Node() as node:
+            for _ in range(3):
+                node.send_output("data", payload)
+    """))
+    spec = {
+        "nodes": [
+            {"id": "sender", "path": "big_sender.py", "outputs": ["data"]},
+            {
+                "id": "relay",
+                "path": str(node),
+                "inputs": {"in": "sender/data"},
+                "outputs": ["echo"],
+            },
+            {"id": "checker", "path": "checker.py", "inputs": {"in": "relay/echo"}},
+        ],
+        "communication": {"local": "shmem"},
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(df, local_comm="shmem", timeout_s=120)
+    assert result.is_ok(), result.errors()
+
+
+CPP_COUNTER = """
+    #include <cstdio>
+    #include "dora_node_api.hpp"
+
+    int main() {
+      dora::Node node;
+      int inputs = 0;
+      while (auto event = node.next()) {
+        if (event.type() == DORA_EVENT_STOP) break;
+        if (event.type() == DORA_EVENT_INPUT) {
+          inputs++;
+          unsigned char byte = (unsigned char)inputs;
+          node.send_output("count", &byte, 1);
+        }
+      }
+      std::printf("cpp node saw %d inputs\\n", inputs);
+      return inputs >= 2 ? 0 : 1;
+    }
+"""
+
+
+def test_cpp_raii_wrapper(tmp_path):
+    node = compile_node(tmp_path, "cpp_counter", CPP_COUNTER, cpp=True)
+    spec = {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[9]", "COUNT": "3"},
+            },
+            {
+                "id": "counter",
+                "path": str(node),
+                "inputs": {"in": "sender/data"},
+                "outputs": ["count"],
+            },
+        ]
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(df, timeout_s=120)
+    assert result.is_ok(), result.errors()
+    log_dir = next((tmp_path / "out").iterdir())
+    assert "cpp node saw 3 inputs" in (log_dir / "log_counter.txt").read_text()

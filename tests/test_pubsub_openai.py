@@ -1,0 +1,112 @@
+"""Pub/sub layer and OpenAI-compatible server tests."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+import threading
+import time
+import urllib.request
+
+import yaml
+
+from dora_tpu.daemon import run_dataflow
+from dora_tpu.transport.pubsub import Broker, TcpPubSub
+
+
+def test_pubsub_tcp_broker():
+    broker = Broker()
+    layer = TcpPubSub(f"127.0.0.1:{broker.port}")
+    got: list[bytes] = []
+    done = threading.Event()
+
+    def on_msg(payload: bytes):
+        got.append(payload)
+        if len(got) == 3:
+            done.set()
+
+    layer.subscribe("sensor/image", on_msg)
+    other = TcpPubSub(f"127.0.0.1:{broker.port}")
+    time.sleep(0.1)  # let the SUB register
+    publisher = other.publisher("sensor/image")
+    noise = other.publisher("sensor/other")
+    for i in range(3):
+        publisher.publish(f"msg-{i}".encode())
+        noise.publish(b"ignore-me")
+    assert done.wait(5), got
+    assert got == [b"msg-0", b"msg-1", b"msg-2"]
+    layer.close()
+    other.close()
+    broker.close()
+
+
+def test_openai_server_dataflow(tmp_path):
+    """HTTP request -> dataflow echo -> HTTP response."""
+    responder = tmp_path / "upper.py"
+    responder.write_text(textwrap.dedent("""
+        import pyarrow as pa
+
+        from dora_tpu.node import Node
+
+        with Node() as node:
+            for event in node:
+                if event["type"] == "INPUT":
+                    text = event["value"][0].as_py()
+                    node.send_output("reply", pa.array([text.upper()]))
+                elif event["type"] == "STOP":
+                    break
+    """))
+    driver = tmp_path / "driver.py"
+    driver.write_text(textwrap.dedent("""
+        import json
+        import time
+        import urllib.request
+
+        from dora_tpu.node import Node
+
+        node = Node()  # participates so the dataflow keeps running
+        time.sleep(0.5)
+        body = json.dumps({
+            "model": "dora-tpu",
+            "messages": [{"role": "user", "content": "hello world"}],
+        }).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:8129/v1/chat/completions",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        for attempt in range(20):
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    reply = json.load(r)
+                break
+            except Exception:
+                time.sleep(0.25)
+        content = reply["choices"][0]["message"]["content"]
+        assert content == "HELLO WORLD", content
+        print("openai roundtrip ok")
+        node.close()
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "api",
+                "path": "module:dora_tpu.nodehub.openai_server",
+                "outputs": ["text"],
+                "inputs": {"response": "upper/reply"},
+                "env": {"PORT": "8129", "MAX_REQUESTS": "1"},
+            },
+            {
+                "id": "upper",
+                "path": "upper.py",
+                "inputs": {"text": "api/text"},
+                "outputs": ["reply"],
+            },
+            {"id": "driver", "path": "driver.py"},
+        ]
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(df, timeout_s=120)
+    assert result.is_ok(), result.errors()
+    log_dir = next((tmp_path / "out").iterdir())
+    assert "openai roundtrip ok" in (log_dir / "log_driver.txt").read_text()

@@ -1,0 +1,134 @@
+"""ROS2 bridge tests: IDL parser + Arrow conversion (mirrors the
+reference's msg-gen parser unit tests; the DDS transport is gated on
+rclpy and not exercised here)."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+import pytest
+
+from dora_tpu.ros2 import (
+    TypeRef,
+    find_interface,
+    parse_action,
+    parse_message,
+    parse_service,
+)
+from dora_tpu.ros2.arrow_convert import arrow_type, from_arrow, to_arrow
+
+
+class TestParser:
+    def test_primitive_fields(self):
+        spec = parse_message(
+            """
+            # a header comment
+            int32 x
+            float64 y  # trailing comment
+            string name
+            bool flag true
+            """,
+            package="geometry_msgs",
+            name="Test",
+        )
+        assert [f.name for f in spec.fields] == ["x", "y", "name", "flag"]
+        assert spec.fields[1].type.base == "float64"
+        assert spec.fields[3].default is True
+        assert spec.full_name == "geometry_msgs/Test"
+
+    def test_arrays_and_bounds(self):
+        spec = parse_message(
+            """
+            int32[] unbounded
+            float32[9] fixed
+            uint8[<=64] bounded
+            string<=10 short_name
+            """
+        )
+        t0, t1, t2, t3 = (f.type for f in spec.fields)
+        assert t0.is_array and t0.array_size is None and t0.array_bound is None
+        assert t1.array_size == 9
+        assert t2.array_bound == 64
+        assert t3.string_bound == 10 and not t3.is_array
+
+    def test_constants(self):
+        spec = parse_message(
+            """
+            uint8 DEBUG=1
+            uint8 INFO=2
+            string FOO="ba#r"
+            uint8 level
+            """
+        )
+        assert [c.name for c in spec.constants] == ["DEBUG", "INFO", "FOO"]
+        assert spec.constants[2].value == "ba#r"
+        assert [f.name for f in spec.fields] == ["level"]
+
+    def test_nested_and_relative_types(self):
+        spec = parse_message(
+            "geometry_msgs/Point position\nQuaternion orientation",
+            package="geometry_msgs",
+            name="Pose",
+        )
+        assert spec.fields[0].type.base == "geometry_msgs/Point"
+        # Relative reference resolves to the same package.
+        assert spec.fields[1].type.base == "geometry_msgs/Quaternion"
+
+    def test_service_sections(self):
+        srv = parse_service(
+            "int64 a\nint64 b\n---\nint64 sum\n",
+            package="example_interfaces",
+            name="AddTwoInts",
+        )
+        assert [f.name for f in srv.request.fields] == ["a", "b"]
+        assert [f.name for f in srv.response.fields] == ["sum"]
+
+    def test_action_sections(self):
+        action = parse_action(
+            "int32 order\n---\nint32[] sequence\n---\nint32[] partial\n",
+            package="example_interfaces",
+            name="Fibonacci",
+        )
+        assert action.goal.fields[0].name == "order"
+        assert action.result.fields[0].name == "sequence"
+        assert action.feedback.fields[0].name == "partial"
+
+    def test_find_interface(self, tmp_path):
+        share = tmp_path / "share" / "std_msgs" / "msg"
+        share.mkdir(parents=True)
+        (share / "Header.msg").write_text("uint32 seq\nstring frame_id\n")
+        spec = find_interface("std_msgs/Header", str(tmp_path))
+        assert [f.name for f in spec.fields] == ["seq", "frame_id"]
+
+
+class TestArrowConvert:
+    def test_roundtrip_flat(self):
+        spec = parse_message("int32 x\nfloat64 y\nstring label\n")
+        msgs = [
+            {"x": 1, "y": 2.5, "label": "a"},
+            {"x": 2, "y": -1.0, "label": "b"},
+        ]
+        arr = to_arrow(msgs, spec)
+        assert pa.types.is_struct(arr.type)
+        assert from_arrow(arr) == msgs
+
+    def test_defaults_and_zeros(self):
+        spec = parse_message("int32 x 7\nfloat32[] data\nbool ok\n")
+        arr = to_arrow([{}], spec)
+        assert from_arrow(arr) == [{"x": 7, "data": [], "ok": False}]
+
+    def test_nested_struct(self):
+        point = parse_message("float64 x\nfloat64 y\n", "geo", "Point")
+        pose = parse_message("geo/Point position\nint32 id\n", "geo", "Pose")
+        arr = to_arrow(
+            [{"position": {"x": 1.0, "y": 2.0}, "id": 5}],
+            pose,
+            resolve=lambda name: point,
+        )
+        typ = arrow_type(pose, resolve=lambda name: point)
+        assert pa.types.is_struct(typ.field("position").type)
+        assert from_arrow(arr)[0]["position"]["y"] == 2.0
+
+    def test_fixed_size_list(self):
+        spec = parse_message("float32[3] vec\n")
+        arr = to_arrow([{"vec": [1.0, 2.0, 3.0]}], spec)
+        assert pa.types.is_fixed_size_list(arr.type.field("vec").type)
