@@ -81,6 +81,18 @@ def _check_custom_source(node_id, kind: CustomNode, working_dir: Path | None) ->
     raise ValidationError(f"node {node_id!r}: source {source!r} not found")
 
 
+def adjust_shared_library_path(path: Path) -> Path:
+    """'op' -> 'libop.so' / 'op.so' when the bare name does not exist
+    (reference: adjust_shared_library_path, libraries/core/src/lib.rs:14-31)."""
+    if path.exists():
+        return path
+    for candidate in (path.with_name(f"lib{path.name}.so"),
+                      path.with_name(f"{path.name}.so")):
+        if candidate.exists():
+            return candidate
+    return path
+
+
 def _check_operator_source(node_id, op_id, source, working_dir: Path | None) -> None:
     if isinstance(source, (PythonSource, SharedLibrarySource)):
         src = source.source
@@ -89,6 +101,8 @@ def _check_operator_source(node_id, op_id, source, working_dir: Path | None) -> 
         path = Path(src)
         if working_dir and not path.is_absolute():
             path = working_dir / path
+        if isinstance(source, SharedLibrarySource):
+            path = adjust_shared_library_path(path)
         if not path.exists():
             raise ValidationError(
                 f"operator {node_id}/{op_id}: source {src!r} not found"

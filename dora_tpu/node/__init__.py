@@ -249,6 +249,52 @@ class Node:
             )
         )
 
+    def allocate_sample(self, size: int) -> "DataSample":
+        """Allocate a writable sample backed by a shared-memory region
+        (reference: allocate_data_sample + DataSample,
+        apis/rust/node/src/node/mod.rs:303-319,434-503). Fill
+        ``sample.view[:n]`` and publish with :meth:`send_sample` — the
+        producer-side copy disappears entirely."""
+        if size < ZERO_COPY_THRESHOLD:
+            return DataSample(self, None, None, bytearray(size))
+        region, token = self._alloc_region(size)
+        return DataSample(self, region, token, None)
+
+    def send_sample(
+        self,
+        output_id: str,
+        sample: "DataSample",
+        length: int,
+        metadata: dict | None = None,
+        encoding: str = ENCODING_RAW,
+    ) -> None:
+        """Publish a filled sample (no copy for shmem-backed samples)."""
+        if output_id not in self._config.run_config.outputs:
+            raise DaemonError(
+                f"node {self.node_id!r} has no output {output_id!r}"
+            )
+        if sample._sent:
+            raise DaemonError("sample was already sent")
+        sample._sent = True
+        if sample._region is not None:
+            message_data: Any = SharedMemoryData(
+                shmem_id=sample._region.name,
+                len=length,
+                drop_token=sample._token,
+            )
+        else:
+            message_data = InlineData(data=bytes(sample._inline[:length]))
+        self._control.request(
+            n2d.SendMessage(
+                output_id=output_id,
+                metadata=Metadata(
+                    type_info=TypeInfo(encoding=encoding, len=length),
+                    parameters=dict(metadata or {}),
+                ),
+                data=message_data,
+            )
+        )
+
     def _pack_payload_raw(self, raw: bytes) -> Any:
         if len(raw) >= ZERO_COPY_THRESHOLD:
             region, token = self._alloc_region(len(raw))
@@ -374,4 +420,27 @@ class Node:
             pass
 
 
-__all__ = ["Node", "Event", "DaemonError"]
+class DataSample:
+    """A writable payload buffer, shmem-backed when ≥ 4 KiB."""
+
+    __slots__ = ("_node", "_region", "_token", "_inline", "_sent")
+
+    def __init__(self, node, region, token, inline):
+        self._node = node
+        self._region = region
+        self._token = token
+        self._inline = inline
+        self._sent = False
+
+    @property
+    def view(self) -> memoryview:
+        """The writable bytes (do not hold slices past send)."""
+        if self._region is not None:
+            return memoryview(self._region)
+        return memoryview(self._inline)
+
+    def __len__(self) -> int:
+        return self._region.size if self._region is not None else len(self._inline)
+
+
+__all__ = ["Node", "Event", "DataSample", "DaemonError"]

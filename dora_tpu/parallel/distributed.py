@@ -1,0 +1,66 @@
+"""Multi-host JAX initialization for distributed dataflows.
+
+Reference parity: the reference's multi-machine axis is daemon-per-machine
+with TCP forwarding (SURVEY §2.9); the TPU build adds the tensor plane:
+one daemon per TPU host, `jax.distributed` across hosts (DCN), XLA
+collectives over ICI within a slice. The daemon exposes its machine id
+and the coordinator address via environment variables when spawning
+nodes, so a TPU-tier runtime node on every host of a slice can join the
+same global mesh.
+
+Env contract (set per node in the dataflow YAML, or by the deployment):
+
+  DORA_JAX_COORDINATOR   host:port of process 0 (jax.distributed)
+  DORA_JAX_NUM_PROCESSES total process count
+  DORA_JAX_PROCESS_ID    this process's index
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_init_distributed() -> bool:
+    """Initialize jax.distributed from the env contract if present.
+
+    Returns True when running multi-host (after init), False for
+    single-host. Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = os.environ.get("DORA_JAX_COORDINATOR")
+    if not coordinator:
+        return False
+    import jax
+
+    num_processes = int(os.environ.get("DORA_JAX_NUM_PROCESSES", "1"))
+    process_id = int(os.environ.get("DORA_JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global devices",
+        process_id, num_processes, len(jax.devices()),
+    )
+    return True
+
+
+def global_mesh(dp: int = -1, tp: int = 1, sp: int = 1):
+    """A mesh over all global devices (multi-host aware): call after
+    maybe_init_distributed(). Lay tp/sp on the fastest (ICI) axis by
+    keeping them within a host where possible."""
+    import jax
+
+    from dora_tpu.parallel.mesh import make_mesh
+
+    maybe_init_distributed()
+    return make_mesh(dp=dp, tp=tp, sp=sp, devices=jax.devices())

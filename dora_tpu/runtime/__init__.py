@@ -26,6 +26,7 @@ from dora_tpu.core.descriptor import (
     OperatorDefinition,
     PythonSource,
     RuntimeNode,
+    SharedLibrarySource,
 )
 from dora_tpu.node import Node
 from dora_tpu.tpu.api import DoraStatus
@@ -112,11 +113,17 @@ def run() -> int:
         raise RuntimeError(f"node {node.node_id!r} is not a runtime node")
     working_dir = Path.cwd()
 
-    python_hosts: dict[str, PythonOperatorHost] = {}
+    python_hosts: dict[str, Any] = {}  # callback-style hosts (python + C ABI)
     has_jax = False
     for op in me.kind.operators:
         if isinstance(op.source, PythonSource):
             python_hosts[str(op.id)] = PythonOperatorHost(op, node, working_dir)
+        elif isinstance(op.source, SharedLibrarySource):
+            from dora_tpu.runtime.shared_lib import SharedLibOperatorHost
+
+            python_hosts[str(op.id)] = SharedLibOperatorHost(
+                op, node, working_dir
+            )
         elif isinstance(op.source, JaxSource):
             has_jax = True
 
@@ -160,7 +167,8 @@ def run() -> int:
             target = event.get("operator_id")
             for op_id, host in python_hosts.items():
                 if target in (None, op_id):
-                    host.reload()
+                    if hasattr(host, "reload"):  # C-ABI ops don't hot-reload
+                        host.reload()
         elif event["type"] == "INPUT_CLOSED":
             continue
         elif event["type"] == "STOP":
@@ -179,5 +187,8 @@ def run() -> int:
                                "metadata": {}})
             except Exception:
                 pass
+        close = getattr(host, "close", None)
+        if close is not None:
+            close()
     node.close()
     return 0

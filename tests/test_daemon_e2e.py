@@ -171,6 +171,50 @@ def test_queue_size_drop_oldest(tmp_path):
     assert result.is_ok(), result.errors()
 
 
+def test_allocate_sample_zero_copy_send(tmp_path):
+    """The DataSample producer API: write directly into the shared region,
+    publish with no producer-side copy."""
+    sender = tmp_path / "sample_sender.py"
+    sender.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        N = 100_000
+        with Node() as node:
+            sample = node.allocate_sample(N)
+            view = sample.view
+            view[:N] = bytes(range(256)) * 390 + bytes(160)
+            view.release()
+            node.send_sample("data", sample, N)
+    """))
+    receiver = tmp_path / "sample_receiver.py"
+    receiver.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        node = Node()
+        seen = 0
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            data = bytes(event["value"])
+            assert data == bytes(range(256)) * 390 + bytes(160)
+            seen += 1
+        node.close()
+        assert seen == 1, seen
+        print("sample ok")
+    """))
+    spec = {
+        "nodes": [
+            {"id": "sender", "path": "sample_sender.py", "outputs": ["data"]},
+            {"id": "receiver", "path": "sample_receiver.py",
+             "inputs": {"in": "sender/data"}},
+        ],
+        "communication": {"local": "shmem"},
+    }
+    result = run_dataflow(write_dataflow(tmp_path, spec), local_comm="shmem",
+                          timeout_s=60)
+    assert result.is_ok(), result.errors()
+
+
 def test_failing_node_reported(tmp_path):
     """A node exiting nonzero is reported with its stderr tail; the dataflow
     result is not ok."""
