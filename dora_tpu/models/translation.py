@@ -1,0 +1,131 @@
+"""Text-to-text translation (Opus-MT class), TPU-native.
+
+Reference parity: node-hub/dora-opus and dora-argotranslate serve
+translation models through torch/ctranslate (SURVEY §2.4). JAX
+counterpart: an encoder-decoder transformer over token ids with
+cross-attention and greedy decode as one jit — the same machinery as the
+ASR decoder minus the audio frontend, so the architecture is shared via
+dora_tpu.models.layers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.asr import (
+    _cross_attend,
+    _cross_block_init,
+)
+
+
+@dataclass(frozen=True)
+class TranslatorConfig:
+    vocab: int = 8192
+    dim: int = 384
+    enc_layers: int = 4
+    dec_layers: int = 4
+    heads: int = 6
+    ffn: int = 1536
+    max_src: int = 256
+    max_tokens: int = 256
+
+    @classmethod
+    def tiny(cls) -> "TranslatorConfig":
+        return cls(vocab=300, dim=64, enc_layers=2, dec_layers=2, heads=4,
+                   ffn=128, max_src=32, max_tokens=16)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def init_params(key, cfg: TranslatorConfig) -> dict:
+    keys = iter(jax.random.split(key, 8 + cfg.enc_layers + cfg.dec_layers))
+    return {
+        "embed": L.embed_init(next(keys), cfg.vocab, cfg.dim),
+        "enc_blocks": {
+            str(i): L.init_block(next(keys), cfg.dim, cfg.heads, cfg.ffn)
+            for i in range(cfg.enc_layers)
+        },
+        "enc_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "dec_blocks": {
+            str(i): _cross_block_init(next(keys), cfg.dim, cfg.heads, cfg.ffn)
+            for i in range(cfg.dec_layers)
+        },
+        "dec_norm": jnp.ones((cfg.dim,), jnp.float32),
+    }
+
+
+def encode(params, cfg: TranslatorConfig, src_ids):
+    """src_ids [B, S] -> encoder states [B, S, dim] (RoPE positions)."""
+    dtype = L.compute_dtype()
+    x = params["embed"].astype(dtype)[src_ids]
+    rope = L.rope_table(cfg.max_src, cfg.head_dim)
+    b, s = src_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    for i in range(cfg.enc_layers):
+        x, _ = L.block_forward(
+            params["enc_blocks"][str(i)], x, cfg.heads,
+            rope=rope, positions=positions,
+        )
+    return L.rms_norm(x, params["enc_norm"])
+
+
+@partial(jax.jit, static_argnums=(1, 4))
+def translate(params, cfg: TranslatorConfig, src_ids, bos_token,
+              max_new_tokens: int):
+    """Greedy translation: [B, S] -> [B, max_new_tokens] int32, one XLA
+    program (encoder + scan over cached decode steps)."""
+    dtype = L.compute_dtype()
+    enc = encode(params, cfg, src_ids)
+    b, s, _ = enc.shape
+    kv = {}
+    for i in range(cfg.dec_layers):
+        block = params["dec_blocks"][str(i)]
+        k = (enc @ block["x_wk"].astype(dtype)).reshape(
+            b, s, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = (enc @ block["x_wv"].astype(dtype)).reshape(
+            b, s, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        kv[str(i)] = (k, v)
+
+    caches = {
+        str(i): {
+            "k": jnp.zeros((b, cfg.heads, cfg.max_tokens, cfg.head_dim), dtype),
+            "v": jnp.zeros((b, cfg.heads, cfg.max_tokens, cfg.head_dim), dtype),
+        }
+        for i in range(cfg.dec_layers)
+    }
+    rope = L.rope_table(cfg.max_tokens, cfg.head_dim)
+    embed = params["embed"].astype(dtype)
+    head = embed.T  # tied softmax head
+
+    def step(carry, _):
+        token, caches, pos = carry
+        h = embed[token][:, None, :]
+        positions = jnp.broadcast_to(pos, (b, 1))
+        mask = (jnp.arange(cfg.max_tokens) <= pos)[None, None, None, :]
+        new_caches = {}
+        for i in range(cfg.dec_layers):
+            block = params["dec_blocks"][str(i)]
+            h, c = L.block_forward(
+                block, h, cfg.heads, rope=rope, positions=positions,
+                mask=mask, cache=caches[str(i)], cache_index=pos,
+            )
+            new_caches[str(i)] = c
+            h = _cross_attend(block, h, kv[str(i)], cfg.heads)
+        h = L.rms_norm(h, params["dec_norm"])
+        logits = (h[:, -1] @ head).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, new_caches, pos + 1), nxt
+
+    start = jnp.full((b,), bos_token, jnp.int32)
+    _, tokens = jax.lax.scan(
+        step, (start, caches, jnp.asarray(0, jnp.int32)), None,
+        length=max_new_tokens,
+    )
+    return tokens.T
