@@ -79,7 +79,14 @@ def dataflow_p50_us(workdir: Path) -> float:
             for event in node:
                 if event["type"] != "INPUT":
                     continue
-                node.send_output("data", payload, {{"t": time.perf_counter_ns()}})
+                # Zero-producer-copy path: produce the payload directly into
+                # the shared region (a real producer writes in place), then
+                # publish the region itself.
+                sample = node.allocate_sample({SIZE})
+                sample.view[:{SIZE}] = payload
+                node.send_sample(
+                    "data", sample, {SIZE}, {{"t": time.perf_counter_ns()}}
+                )
                 sent += 1
                 if sent >= {ROUNDS}:
                     break

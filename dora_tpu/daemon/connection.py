@@ -96,8 +96,15 @@ class ShmemConnection(NodeConnection):
         return await self._incoming.get()
 
     async def send(self, payload: bytes) -> None:
-        loop = asyncio.get_running_loop()
+        # Fast path: under request-reply discipline the requester is parked
+        # in recv, so the reply slot is free — send inline (memcpy + futex
+        # wake, a few µs) instead of paying an executor-thread hop. Fall
+        # back to a blocking send off-loop only if the slot is occupied
+        # (pipelined fire-and-forget peer or stuck client).
         try:
+            if self.channel.try_send(payload):
+                return
+            loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, self.channel.send, payload)
         except Disconnected:
             raise ConnectionClosed("shmem peer disconnected") from None

@@ -114,6 +114,10 @@ def _load() -> ctypes.CDLL:
         lib.dtp_channel_send.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
         ]
+        lib.dtp_channel_try_send.restype = ctypes.c_int
+        lib.dtp_channel_try_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+        ]
         lib.dtp_channel_recv.restype = ctypes.c_int64
         lib.dtp_channel_recv.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
@@ -255,11 +259,28 @@ class ShmemChannel:
         rc = self._lib.dtp_channel_send(
             self._h, data, len(data), 1 if self.is_server else 0
         )
+        self._check_send_rc(rc, len(data))
+
+    def try_send(self, data: bytes) -> bool:
+        """Non-blocking send; False when the previous message in this
+        direction is still unconsumed (caller should fall back to a
+        blocking send off the hot thread)."""
+        if not self._h:
+            raise ShmemError(f"channel {self.name} is closed")
+        rc = self._lib.dtp_channel_try_send(
+            self._h, data, len(data), 1 if self.is_server else 0
+        )
+        if rc == -1:
+            return False
+        self._check_send_rc(rc, len(data))
+        return True
+
+    def _check_send_rc(self, rc: int, size: int) -> None:
         if rc == -2:
             raise Disconnected(f"channel {self.name} disconnected")
         if rc == -3:
             raise ShmemError(
-                f"message of {len(data)} B exceeds channel capacity {self.capacity}"
+                f"message of {size} B exceeds channel capacity {self.capacity}"
             )
         if rc != 0:
             raise ShmemError(f"send failed with {rc}")

@@ -1,0 +1,78 @@
+"""Benchmark source node: latency + throughput sweep over message sizes.
+
+Reference parity: examples/benchmark/node/src/main.rs:15-70 — for each
+size (0 B -> 4 MB by default) send a paced batch for latency measurement,
+then a full-speed batch for throughput measurement.
+
+TPU-first difference: payloads travel through the zero-producer-copy
+``allocate_sample`` path (the region IS the message; nothing is copied on
+either side), where the reference's `send_output` performs one producer
+copy (apis/rust/node/src/node/arrow_utils.rs:23-71).
+
+Configured via env:
+  BENCH_SIZES           comma-separated byte sizes
+  BENCH_LATENCY_ROUNDS  messages per size for the latency phase (default 100)
+  BENCH_THROUGHPUT_ROUNDS  messages per size for the throughput phase (default 100)
+  BENCH_SPACING_MS      latency-phase send spacing (default 10 ms)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from dora_tpu.node import Node
+
+DEFAULT_SIZES = "0,8,64,512,2048,4096,16384,131072,1048576,4194304"
+
+
+def _sizes() -> list[int]:
+    return [int(s) for s in os.environ.get("BENCH_SIZES", DEFAULT_SIZES).split(",")]
+
+
+def _fill(sample, size: int) -> None:
+    # Produce the payload in place (a real producer writes into the region —
+    # camera DMA, codec output, jax device->host into a pinned view, ...).
+    view = sample.view
+    view[:size] = b"\xab" * size
+
+
+def main() -> None:
+    sizes = _sizes()
+    latency_rounds = int(os.environ.get("BENCH_LATENCY_ROUNDS", "100"))
+    throughput_rounds = int(os.environ.get("BENCH_THROUGHPUT_ROUNDS", "100"))
+    spacing_s = float(os.environ.get("BENCH_SPACING_MS", "10")) / 1e3
+
+    with Node() as node:
+        # Wait for the sink to be up: the start barrier already guarantees it,
+        # so we can begin immediately.
+        for size in sizes:
+            for i in range(latency_rounds):
+                sample = node.allocate_sample(size)
+                _fill(sample, size)
+                node.send_sample(
+                    "latency",
+                    sample,
+                    size,
+                    metadata={
+                        "size": size,
+                        "seq": i,
+                        "n": latency_rounds,
+                        "t": time.perf_counter_ns(),
+                    },
+                )
+                time.sleep(spacing_s)
+        for size in sizes:
+            for i in range(throughput_rounds):
+                sample = node.allocate_sample(size)
+                _fill(sample, size)
+                node.send_sample(
+                    "throughput",
+                    sample,
+                    size,
+                    metadata={"size": size, "seq": i, "n": throughput_rounds},
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Benchmark sink: collects the latency/throughput sweep and prints a table.
+
+Reference parity: examples/benchmark/sink/src/main.rs:70-90 (per-size
+averages printed at the end of the run). Additionally writes machine-readable
+``results.json`` (path from env BENCH_OUT, default ./results.json) with
+p50/p90/avg latency in µs and msgs/s + MB/s throughput per size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from collections import defaultdict
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    out_path = os.environ.get("BENCH_OUT", "results.json")
+    latencies: dict[int, list[float]] = defaultdict(list)  # size -> [us]
+    tp_first: dict[int, int] = {}
+    tp_last: dict[int, int] = {}
+    tp_count: dict[int, int] = defaultdict(int)
+
+    with Node() as node:
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            meta = event["metadata"]
+            size = int(meta["size"])
+            if event["id"] == "latency":
+                now = time.perf_counter_ns()
+                latencies[size].append((now - int(meta["t"])) / 1e3)
+            elif event["id"] == "throughput":
+                now = time.perf_counter_ns()
+                tp_count[size] += 1
+                if size not in tp_first:
+                    tp_first[size] = now
+                tp_last[size] = now
+
+    results = []
+    for size in sorted(set(latencies) | set(tp_count)):
+        row: dict = {"size": size}
+        lats = latencies.get(size)
+        if lats:
+            row["latency_p50_us"] = round(statistics.median(lats), 1)
+            row["latency_p90_us"] = round(
+                statistics.quantiles(lats, n=10)[-1] if len(lats) >= 10 else max(lats),
+                1,
+            )
+            row["latency_avg_us"] = round(statistics.fmean(lats), 1)
+            row["latency_n"] = len(lats)
+        n = tp_count.get(size, 0)
+        if n >= 2:
+            span_s = (tp_last[size] - tp_first[size]) / 1e9
+            if span_s > 0:
+                row["throughput_msgs_s"] = round((n - 1) / span_s, 1)
+                row["throughput_mb_s"] = round((n - 1) * size / span_s / 1e6, 1)
+            row["throughput_n"] = n
+        results.append(row)
+
+    header = f"{'size':>10} {'p50 µs':>10} {'p90 µs':>10} {'avg µs':>10} {'msgs/s':>12} {'MB/s':>10}"
+    print(header)
+    for row in results:
+        print(
+            f"{row['size']:>10} "
+            f"{row.get('latency_p50_us', '-'):>10} "
+            f"{row.get('latency_p90_us', '-'):>10} "
+            f"{row.get('latency_avg_us', '-'):>10} "
+            f"{row.get('throughput_msgs_s', '-'):>12} "
+            f"{row.get('throughput_mb_s', '-'):>10}"
+        )
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Standalone-daemon runner for the benchmark example
+(reference: examples/benchmark/run.rs — build then `dora daemon --run-dataflow`).
+
+Usage: python examples/benchmark/run.py [--quick]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from dora_tpu.daemon import run_dataflow
+
+
+def main() -> int:
+    here = Path(__file__).resolve().parent
+    if "--quick" in sys.argv:
+        import os
+
+        os.environ.setdefault("BENCH_SIZES", "0,4096,1048576")
+        os.environ.setdefault("BENCH_LATENCY_ROUNDS", "20")
+        os.environ.setdefault("BENCH_THROUGHPUT_ROUNDS", "50")
+        os.environ.setdefault("BENCH_SPACING_MS", "2")
+    result = run_dataflow(here / "dataflow.yml", local_comm="shmem", timeout_s=600)
+    if not result.is_ok():
+        print(f"benchmark dataflow failed: {result.errors()}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,8 @@ int dtp_region_unlink(const char* name);
 void* dtp_channel_create(const char* name, uint32_t capacity);
 void* dtp_channel_open(const char* name);
 uint32_t dtp_channel_capacity(void* chan);
+int dtp_channel_try_send(void* chan, const uint8_t* data, uint64_t len,
+                         int is_server);
 int dtp_channel_send(void* chan, const uint8_t* data, uint64_t len,
                      int is_server);
 int64_t dtp_channel_recv(void* chan, uint8_t* out, uint64_t out_cap,

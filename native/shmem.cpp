@@ -205,30 +205,42 @@ uint32_t dtp_channel_capacity(void* chan) {
   return h->capacity;
 }
 
-// Write a message and signal the peer. is_server: 1 when the daemon side
-// sends (signals client_event), 0 when the node side sends. Blocks until
-// the peer consumed any previous message in this direction.
-// Returns 0 ok, -2 disconnected, -3 message too large.
-int dtp_channel_send(void* chan, const uint8_t* data, uint64_t len,
-                     int is_server) {
+// Non-blocking send: write a message and signal the peer, or return -1
+// immediately when the previous message in this direction is still
+// unconsumed. is_server: 1 when the daemon side sends (signals
+// client_event), 0 when the node side sends. Lets the daemon's event loop
+// send replies inline (the requester is parked in recv, so the slot is
+// free) without risking a blocked loop on a stuck peer.
+// Returns 0 ok, -1 would block, -2 disconnected, -3 message too large.
+int dtp_channel_try_send(void* chan, const uint8_t* data, uint64_t len,
+                         int is_server) {
   Region* r = static_cast<Region*>(chan);
   auto* h = static_cast<ChannelHeader*>(r->ptr);
   if (len > h->capacity) return -3;
+  if (h->disconnected.load(std::memory_order_acquire)) return -2;
   auto& pending = is_server ? h->s2c_pending : h->c2s_pending;
-  auto& free_ev = is_server ? h->s2c_free : h->c2s_free;
-  for (;;) {
-    if (h->disconnected.load(std::memory_order_acquire)) return -2;
-    uint32_t expected = 0;
-    if (pending.compare_exchange_strong(expected, 1,
-                                        std::memory_order_acq_rel)) {
-      break;
-    }
-    free_ev.wait(100);  // slice so disconnects are noticed
+  uint32_t expected = 0;
+  if (!pending.compare_exchange_strong(expected, 1,
+                                       std::memory_order_acq_rel)) {
+    return -1;
   }
   memcpy(static_cast<uint8_t*>(r->ptr) + kPayloadOffset, data, len);
   h->len.store(len, std::memory_order_release);
   (is_server ? h->client_event : h->server_event).set();
   return 0;
+}
+
+// Blocking send: retries try_send until the direction's slot frees up.
+// Returns 0 ok, -2 disconnected, -3 message too large.
+int dtp_channel_send(void* chan, const uint8_t* data, uint64_t len,
+                     int is_server) {
+  auto* h = static_cast<ChannelHeader*>(static_cast<Region*>(chan)->ptr);
+  auto& free_ev = is_server ? h->s2c_free : h->c2s_free;
+  for (;;) {
+    int rc = dtp_channel_try_send(chan, data, len, is_server);
+    if (rc != -1) return rc;
+    free_ev.wait(100);  // slice so disconnects are noticed
+  }
 }
 
 // Wait for a message from the peer and copy it into out (size out_cap).

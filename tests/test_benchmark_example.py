@@ -1,0 +1,42 @@
+"""End-to-end test of the examples/benchmark latency+throughput sweep
+(reference: examples/benchmark/{node,sink}/src/main.rs)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_benchmark_sweep(tmp_path):
+    out = tmp_path / "results.json"
+    env = {
+        "BENCH_SIZES": "0,4096,65536",
+        "BENCH_LATENCY_ROUNDS": "10",
+        "BENCH_THROUGHPUT_ROUNDS": "20",
+        "BENCH_SPACING_MS": "2",
+        "BENCH_OUT": str(out),
+    }
+    import os
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "benchmark" / "run.py")],
+        env={**os.environ, **env},
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    results = json.loads(out.read_text())
+    sizes = {r["size"] for r in results}
+    assert sizes == {0, 4096, 65536}
+    for row in results:
+        # Latency numbers present and sane (< 1 s).
+        assert 0 < row["latency_p50_us"] < 1e6
+        assert row["latency_n"] == 10
+        # Full-speed phase delivered every message (queue_size is large).
+        assert row["throughput_n"] == 20
+        assert row["throughput_msgs_s"] > 10
