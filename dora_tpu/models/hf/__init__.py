@@ -1,0 +1,18 @@
+"""Pretrained-checkpoint serving: HuggingFace safetensors → JAX params.
+
+The reference's AI nodes serve pretrained torch checkpoints directly
+(node-hub/dora-qwenvl/dora_qwenvl/main.py:24-56, dora-distil-whisper/
+dora_distil_whisper/main.py:20-40). This subpackage is the TPU-native
+counterpart: read a HF checkpoint directory (config.json +
+model.safetensors[.index.json]) into a JAX parameter pytree laid out for
+the shared transformer block (`dora_tpu.models.layers`), and run the
+faithful forward pass under jit — bfloat16 on the MXU, greedy decode as a
+`lax.scan`.
+
+Numeric parity with the upstream torch implementations is asserted in
+tests/test_hf_parity.py against transformers' own forward pass.
+"""
+
+from dora_tpu.models.hf.loader import read_config, read_safetensors
+
+__all__ = ["read_config", "read_safetensors"]

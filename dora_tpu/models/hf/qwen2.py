@@ -1,0 +1,190 @@
+"""Qwen2-family causal LM serving pretrained HF checkpoints.
+
+Faithful to transformers' `Qwen2ForCausalLM` compute graph (RMSNorm,
+NeoX-style RoPE with configurable theta, GQA, SwiGLU, q/k/v biases) so
+real checkpoint weights produce the same logits — asserted numerically in
+tests/test_hf_parity.py. Reference serves this family through torch
+(node-hub/dora-qwenvl/dora_qwenvl/main.py:24-56); here the whole
+prefill+decode path jits into XLA programs with a static-shape KV cache.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.hf.loader import (
+    linear,
+    maybe_bias,
+    read_config,
+    read_safetensors,
+)
+
+
+@dataclass(frozen=True)
+class Qwen2Config:
+    vocab: int
+    dim: int
+    layers: int
+    heads: int
+    kv_heads: int
+    ffn: int
+    rope_theta: float
+    norm_eps: float
+    tie_embeddings: bool
+    max_seq: int = 2048
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    @classmethod
+    def from_hf(cls, config: dict, max_seq: int | None = None) -> "Qwen2Config":
+        return cls(
+            vocab=config["vocab_size"],
+            dim=config["hidden_size"],
+            layers=config["num_hidden_layers"],
+            heads=config["num_attention_heads"],
+            kv_heads=config.get("num_key_value_heads", config["num_attention_heads"]),
+            ffn=config["intermediate_size"],
+            rope_theta=config.get("rope_theta", 10000.0),
+            norm_eps=config.get("rms_norm_eps", 1e-6),
+            tie_embeddings=config.get("tie_word_embeddings", False),
+            max_seq=max_seq
+            or min(config.get("max_position_embeddings", 2048), 2048),
+        )
+
+
+def load(model_dir: str | Path, max_seq: int | None = None):
+    """(config, params) from a HF checkpoint directory."""
+    hf_config = read_config(model_dir)
+    cfg = Qwen2Config.from_hf(hf_config, max_seq)
+    tensors = read_safetensors(model_dir)
+    prefix = "model." if any(k.startswith("model.") for k in tensors) else ""
+    params = map_params(tensors, cfg, prefix)
+    return cfg, params
+
+
+def map_params(tensors: dict, cfg: Qwen2Config, prefix: str = "model.") -> dict:
+    """Checkpoint names → the shared-block parameter layout."""
+    params: dict[str, Any] = {
+        "embed": tensors[f"{prefix}embed_tokens.weight"],
+        "out_norm": tensors[f"{prefix}norm.weight"],
+        "blocks": {},
+    }
+    for i in range(cfg.layers):
+        lp = f"{prefix}layers.{i}."
+        block: dict[str, Any] = {
+            "attn_norm": tensors[lp + "input_layernorm.weight"],
+            "wq": linear(tensors, lp + "self_attn.q_proj.weight"),
+            "wk": linear(tensors, lp + "self_attn.k_proj.weight"),
+            "wv": linear(tensors, lp + "self_attn.v_proj.weight"),
+            "wo": linear(tensors, lp + "self_attn.o_proj.weight"),
+            "ffn_norm": tensors[lp + "post_attention_layernorm.weight"],
+            "w_gate": linear(tensors, lp + "mlp.gate_proj.weight"),
+            "w_up": linear(tensors, lp + "mlp.up_proj.weight"),
+            "w_down": linear(tensors, lp + "mlp.down_proj.weight"),
+        }
+        maybe_bias(block, "bq", tensors, lp + "self_attn.q_proj.bias")
+        maybe_bias(block, "bk", tensors, lp + "self_attn.k_proj.bias")
+        maybe_bias(block, "bv", tensors, lp + "self_attn.v_proj.bias")
+        maybe_bias(block, "bo", tensors, lp + "self_attn.o_proj.bias")
+        params["blocks"][str(i)] = block
+    if not cfg.tie_embeddings and "lm_head.weight" in tensors:
+        params["lm_head"] = linear(tensors, "lm_head.weight")
+    return jax.tree.map(jnp.asarray, params)
+
+
+def _head(params, cfg: Qwen2Config, dtype):
+    if cfg.tie_embeddings or "lm_head" not in params:
+        return params["embed"].astype(dtype).T
+    return params["lm_head"].astype(dtype)
+
+
+def _lm(params, cfg: Qwen2Config, h, positions, mask, caches=None, cache_index=None):
+    rope = L.rope_table(cfg.max_seq, cfg.head_dim, base=cfg.rope_theta)
+    new_caches = {}
+    for i in range(cfg.layers):
+        h, new_cache = L.block_forward(
+            params["blocks"][str(i)], h, cfg.heads,
+            n_kv_heads=cfg.kv_heads, rope=rope, positions=positions,
+            mask=mask, cache=None if caches is None else caches[str(i)],
+            cache_index=cache_index, norm_eps=cfg.norm_eps,
+        )
+        if new_cache is not None:
+            new_caches[str(i)] = new_cache
+    return L.rms_norm(h, params["out_norm"], cfg.norm_eps), new_caches
+
+
+@partial(jax.jit, static_argnums=(1,))
+def forward(params, cfg: Qwen2Config, tokens):
+    """tokens [B, T] int32 → logits [B, T, vocab] float32."""
+    dtype = L.compute_dtype()
+    b, t = tokens.shape
+    h = params["embed"].astype(dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = L.causal_mask(t, t)
+    h, _ = _lm(params, cfg, h, positions, mask)
+    return (h @ _head(params, cfg, dtype)).astype(jnp.float32)
+
+
+def init_cache(cfg: Qwen2Config, batch: int, dtype=None):
+    dtype = dtype or L.compute_dtype()
+    return {
+        str(i): {
+            "k": jnp.zeros((batch, cfg.kv_heads, cfg.max_seq, cfg.head_dim), dtype),
+            "v": jnp.zeros((batch, cfg.kv_heads, cfg.max_seq, cfg.head_dim), dtype),
+        }
+        for i in range(cfg.layers)
+    }
+
+
+@partial(jax.jit, static_argnums=(1, 3))
+def generate(params, cfg: Qwen2Config, prompt_ids, max_new_tokens: int):
+    """Greedy generation as one traced computation. prompt_ids [B, T]."""
+    dtype = L.compute_dtype()
+    b, t = prompt_ids.shape
+    if t + max_new_tokens > cfg.max_seq:
+        # Out-of-bounds cache indices would be silently clamped by XLA,
+        # corrupting the KV cache — fail loudly at trace time instead.
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq ({cfg.max_seq}); reload with a larger max_seq"
+        )
+    head = _head(params, cfg, dtype)
+
+    h = params["embed"].astype(dtype)[prompt_ids]
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = L.causal_mask(t, cfg.max_seq) & (
+        jnp.arange(cfg.max_seq)[None, None, None, :] < t
+    )
+    caches = init_cache(cfg, b)
+    h, caches = _lm(params, cfg, h, positions, mask, caches=caches, cache_index=0)
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    def step(carry, _):
+        token, caches, position = carry
+        h = params["embed"].astype(dtype)[token][:, None, :]
+        positions = jnp.broadcast_to(position, (b, 1))
+        mask = (jnp.arange(cfg.max_seq) <= position)[None, None, None, :]
+        h, caches = _lm(
+            params, cfg, h, positions, mask, caches=caches, cache_index=position
+        )
+        nxt = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+            jnp.int32
+        )
+        return (nxt, caches, position + 1), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (first, caches, jnp.asarray(t, jnp.int32)), None,
+        length=max_new_tokens,
+    )
+    return tokens.T

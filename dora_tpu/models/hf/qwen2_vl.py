@@ -1,0 +1,578 @@
+"""Qwen2-VL serving pretrained HF checkpoints — the flagship VLM family.
+
+Faithful to transformers' `Qwen2VLForConditionalGeneration` compute graph:
+
+* vision tower: flattened-patch conv embed (Conv3d ≡ one matmul), 2-D
+  rotary positions over the (h, w) patch grid, pre-LN blocks with fused
+  qkv + QuickGELU MLP, 2×2 spatial PatchMerger into LM width;
+* text model: Qwen2 blocks with **M-RoPE** (multimodal 3-D rotary:
+  distinct temporal/height/width position channels, standard RoPE for
+  text spans);
+* image features scattered over ``<|image_pad|>`` token positions.
+
+Numeric parity with the torch implementation is asserted in
+tests/test_hf_parity.py. Reference serves this family through torch/CUDA
+(node-hub/dora-qwenvl/dora_qwenvl/main.py:24-56); here prefill and the
+greedy decode scan jit into XLA programs with a static KV cache, bfloat16
+on the MXU.
+
+Position bookkeeping (`get_rope_index`) runs host-side in numpy — prompt
+assembly is host work; everything downstream of the embeddings is traced.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.hf.loader import (
+    linear,
+    maybe_bias,
+    read_config,
+    read_safetensors,
+)
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    depth: int
+    embed_dim: int
+    heads: int
+    mlp_ratio: float
+    patch_size: int
+    temporal_patch_size: int
+    spatial_merge_size: int
+    in_channels: int
+    out_dim: int  # LM hidden size (merger output)
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.heads
+
+    @property
+    def merge_dim(self) -> int:
+        return self.embed_dim * self.spatial_merge_size**2
+
+
+@dataclass(frozen=True)
+class Qwen2VLConfig:
+    vocab: int
+    dim: int
+    layers: int
+    heads: int
+    kv_heads: int
+    ffn: int
+    rope_theta: float
+    norm_eps: float
+    tie_embeddings: bool
+    mrope_section: tuple[int, ...]
+    image_token_id: int
+    video_token_id: int
+    vision_start_token_id: int
+    vision_end_token_id: int
+    vision: VisionConfig
+    max_seq: int = 2048
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    @classmethod
+    def from_hf(cls, config: dict, max_seq: int | None = None) -> "Qwen2VLConfig":
+        vision = config["vision_config"]
+        rope_scaling = config.get("rope_scaling") or {}
+        head_dim = config["hidden_size"] // config["num_attention_heads"]
+        return cls(
+            vocab=config["vocab_size"],
+            dim=config["hidden_size"],
+            layers=config["num_hidden_layers"],
+            heads=config["num_attention_heads"],
+            kv_heads=config.get(
+                "num_key_value_heads", config["num_attention_heads"]
+            ),
+            ffn=config["intermediate_size"],
+            rope_theta=config.get("rope_theta", 1e6),
+            norm_eps=config.get("rms_norm_eps", 1e-6),
+            tie_embeddings=config.get("tie_word_embeddings", False),
+            mrope_section=tuple(
+                rope_scaling.get("mrope_section") or [head_dim // 2]
+            ),
+            image_token_id=config.get("image_token_id", 151655),
+            video_token_id=config.get("video_token_id", 151656),
+            vision_start_token_id=config.get("vision_start_token_id", 151652),
+            vision_end_token_id=config.get("vision_end_token_id", 151653),
+            vision=VisionConfig(
+                depth=vision["depth"],
+                embed_dim=vision["embed_dim"],
+                heads=vision["num_heads"],
+                mlp_ratio=vision.get("mlp_ratio", 4.0),
+                patch_size=vision.get("patch_size", 14),
+                temporal_patch_size=vision.get("temporal_patch_size", 2),
+                spatial_merge_size=vision.get("spatial_merge_size", 2),
+                in_channels=vision.get("in_channels", 3),
+                out_dim=vision.get("hidden_size", config["hidden_size"]),
+            ),
+            max_seq=max_seq
+            or min(config.get("max_position_embeddings", 2048), 2048),
+        )
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def load(model_dir: str | Path, max_seq: int | None = None):
+    """(config, params) from a HF checkpoint directory."""
+    hf_config = read_config(model_dir)
+    cfg = Qwen2VLConfig.from_hf(hf_config, max_seq)
+    tensors = read_safetensors(model_dir)
+    return cfg, map_params(tensors, cfg)
+
+
+def map_params(tensors: dict, cfg: Qwen2VLConfig) -> dict:
+    # Newer checkpoints nest under model.language_model / model.visual;
+    # original Qwen2-VL uses model.* for text and visual.* at top level.
+    if any(k.startswith("model.language_model.") for k in tensors):
+        text_prefix, vis_prefix = "model.language_model.", "model.visual."
+    else:
+        text_prefix, vis_prefix = "model.", "visual."
+
+    from dora_tpu.models.hf import qwen2
+
+    text_cfg = qwen2.Qwen2Config(
+        vocab=cfg.vocab, dim=cfg.dim, layers=cfg.layers, heads=cfg.heads,
+        kv_heads=cfg.kv_heads, ffn=cfg.ffn, rope_theta=cfg.rope_theta,
+        norm_eps=cfg.norm_eps, tie_embeddings=cfg.tie_embeddings,
+        max_seq=cfg.max_seq,
+    )
+    params = qwen2.map_params(tensors, text_cfg, prefix=text_prefix)
+
+    v = cfg.vision
+    vis: dict[str, Any] = {
+        # Conv3d with stride == kernel over flattened patches is one matmul:
+        # [embed, C, tp, ps, ps] -> [C*tp*ps*ps, embed].
+        "patch_proj": np.ascontiguousarray(
+            tensors[vis_prefix + "patch_embed.proj.weight"]
+            .reshape(v.embed_dim, -1)
+            .T
+        ),
+        "blocks": {},
+        "merger_ln": tensors[vis_prefix + "merger.ln_q.weight"],
+        "merger_ln_b": tensors[vis_prefix + "merger.ln_q.bias"],
+        "merger_fc1": linear(tensors, vis_prefix + "merger.mlp.0.weight"),
+        "merger_fc1_b": tensors[vis_prefix + "merger.mlp.0.bias"],
+        "merger_fc2": linear(tensors, vis_prefix + "merger.mlp.2.weight"),
+        "merger_fc2_b": tensors[vis_prefix + "merger.mlp.2.bias"],
+    }
+    for i in range(v.depth):
+        bp = f"{vis_prefix}blocks.{i}."
+        vis["blocks"][str(i)] = {
+            "norm1": tensors[bp + "norm1.weight"],
+            "norm1_b": tensors[bp + "norm1.bias"],
+            "qkv": linear(tensors, bp + "attn.qkv.weight"),
+            "qkv_b": tensors[bp + "attn.qkv.bias"],
+            "proj": linear(tensors, bp + "attn.proj.weight"),
+            "proj_b": tensors[bp + "attn.proj.bias"],
+            "norm2": tensors[bp + "norm2.weight"],
+            "norm2_b": tensors[bp + "norm2.bias"],
+            "fc1": linear(tensors, bp + "mlp.fc1.weight"),
+            "fc1_b": tensors[bp + "mlp.fc1.bias"],
+            "fc2": linear(tensors, bp + "mlp.fc2.weight"),
+            "fc2_b": tensors[bp + "mlp.fc2.bias"],
+        }
+    params["vision"] = jax.tree.map(jnp.asarray, vis)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# vision tower
+# ---------------------------------------------------------------------------
+
+
+def vision_rotary(cfg: VisionConfig, grid_thw: np.ndarray) -> np.ndarray:
+    """Per-patch 2-D rotary angle table [seq, head_dim/2] (host-side;
+    mirrors Qwen2VisionTransformer.rot_pos_emb)."""
+    merge = cfg.spatial_merge_size
+    pos_ids = []
+    for t, h, w in np.asarray(grid_thw):
+        hpos = np.broadcast_to(np.arange(h)[:, None], (h, w))
+        hpos = (
+            hpos.reshape(h // merge, merge, w // merge, merge)
+            .transpose(0, 2, 1, 3)
+            .reshape(-1)
+        )
+        wpos = np.broadcast_to(np.arange(w)[None, :], (h, w))
+        wpos = (
+            wpos.reshape(h // merge, merge, w // merge, merge)
+            .transpose(0, 2, 1, 3)
+            .reshape(-1)
+        )
+        pos_ids.append(np.tile(np.stack([hpos, wpos], axis=-1), (t, 1)))
+    pos = np.concatenate(pos_ids, axis=0)  # [seq, 2]
+    dim = cfg.head_dim // 2  # rotary dim per spatial axis
+    inv_freq = 1.0 / 10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    freqs = pos[:, :, None] * inv_freq[None, None, :]  # [seq, 2, dim/2]
+    return freqs.reshape(pos.shape[0], -1).astype(np.float32)  # [seq, hd/2]
+
+
+def _block_diag_mask(grid_thw: np.ndarray) -> np.ndarray | None:
+    """[1,1,seq,seq] boolean mask limiting attention to each image's own
+    patches (cu_seqlens semantics); None for a single image."""
+    lengths = [int(t * h * w) for t, h, w in np.asarray(grid_thw)]
+    if len(lengths) <= 1:
+        return None
+    seg = np.repeat(np.arange(len(lengths)), lengths)
+    return (seg[:, None] == seg[None, :])[None, None]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _vision_forward(params, cfg: VisionConfig, patches, cos, sin, mask):
+    dtype = L.compute_dtype()
+    vp = params["vision"]
+    x = patches.astype(dtype) @ vp["patch_proj"].astype(dtype)  # [seq, embed]
+    seq = x.shape[0]
+    for i in range(cfg.depth):
+        bp = vp["blocks"][str(i)]
+        h = L.layer_norm(x, bp["norm1"], bp["norm1_b"], eps=1e-6)
+        qkv = (h @ bp["qkv"].astype(dtype)) + bp["qkv_b"].astype(dtype)
+        qkv = qkv.reshape(seq, 3, cfg.heads, cfg.head_dim)
+        q, k, v = (
+            qkv[:, j].transpose(1, 0, 2)[None] for j in range(3)
+        )  # [1,H,seq,hd]
+        q = L.apply_rope_tables(q, cos, sin)
+        k = L.apply_rope_tables(k, cos, sin)
+        out = L.attention(q, k, v, mask)
+        out = out.transpose(0, 2, 1, 3).reshape(seq, cfg.embed_dim)
+        x = x + (out @ bp["proj"].astype(dtype)) + bp["proj_b"].astype(dtype)
+        h = L.layer_norm(x, bp["norm2"], bp["norm2_b"], eps=1e-6)
+        h = (h @ bp["fc1"].astype(dtype)) + bp["fc1_b"].astype(dtype)
+        h = h * jax.nn.sigmoid(1.702 * h)  # QuickGELU
+        x = x + (h @ bp["fc2"].astype(dtype)) + bp["fc2_b"].astype(dtype)
+
+    # PatchMerger: LN then 2x2 spatial groups (sequence order is already
+    # window-major) -> MLP into LM width.
+    x = L.layer_norm(x, vp["merger_ln"], vp["merger_ln_b"], eps=1e-6)
+    x = x.reshape(-1, cfg.merge_dim)
+    x = (x @ vp["merger_fc1"].astype(dtype)) + vp["merger_fc1_b"].astype(dtype)
+    x = jax.nn.gelu(x, approximate=False)
+    return (x @ vp["merger_fc2"].astype(dtype)) + vp["merger_fc2_b"].astype(dtype)
+
+
+def encode_images(params, cfg: Qwen2VLConfig, pixel_values, grid_thw):
+    """pixel_values [seq, C*tp*ps*ps] (HF processor layout) + grid_thw
+    [n_images, 3] → merged image tokens [seq/merge², lm_dim]."""
+    grid_thw = np.asarray(grid_thw)
+    freqs = vision_rotary(cfg.vision, grid_thw)
+    cos, sin = np.cos(freqs), np.sin(freqs)
+    mask = _block_diag_mask(grid_thw)
+    return _vision_forward(
+        params, cfg.vision, jnp.asarray(pixel_values), jnp.asarray(cos),
+        jnp.asarray(sin), None if mask is None else jnp.asarray(mask),
+    )
+
+
+# ---------------------------------------------------------------------------
+# M-RoPE position bookkeeping (host-side; mirrors get_rope_index)
+# ---------------------------------------------------------------------------
+
+
+def rope_index(
+    cfg: Qwen2VLConfig, input_ids: np.ndarray, grid_thw: np.ndarray | None
+) -> tuple[np.ndarray, np.ndarray]:
+    """3-D position ids [3, B, T] + per-row next-position deltas [B]."""
+    input_ids = np.asarray(input_ids)
+    b, t = input_ids.shape
+    if grid_thw is None or len(np.asarray(grid_thw)) == 0:
+        pos = np.broadcast_to(np.arange(t)[None, None], (3, b, t)).copy()
+        return pos, np.zeros((b,), np.int64)
+
+    grid_thw = np.asarray(grid_thw)
+    merge = cfg.vision.spatial_merge_size
+    position_ids = np.zeros((3, b, t), dtype=np.int64)
+    deltas = np.zeros((b,), np.int64)
+    image_index = 0
+    for i in range(b):
+        tokens = input_ids[i].tolist()
+        chunks: list[np.ndarray] = []
+        st = 0
+        while True:
+            try:
+                ed = tokens.index(cfg.image_token_id, st)
+            except ValueError:
+                break
+            gt, gh, gw = grid_thw[image_index]
+            image_index += 1
+            gh, gw = gh // merge, gw // merge
+            st_idx = int(chunks[-1].max()) + 1 if chunks else 0
+            text_len = ed - st
+            chunks.append(
+                np.broadcast_to(np.arange(text_len) + st_idx, (3, text_len))
+            )
+            t_idx = np.repeat(np.arange(gt), gh * gw)
+            h_idx = np.tile(np.repeat(np.arange(gh), gw), gt)
+            w_idx = np.tile(np.arange(gw), gt * gh)
+            chunks.append(np.stack([t_idx, h_idx, w_idx]) + text_len + st_idx)
+            st = ed + gt * gh * gw
+        if st < len(tokens):
+            st_idx = int(chunks[-1].max()) + 1 if chunks else 0
+            rest = len(tokens) - st
+            chunks.append(np.broadcast_to(np.arange(rest) + st_idx, (3, rest)))
+        pos = np.concatenate(chunks, axis=1)
+        position_ids[:, i, :] = pos
+        deltas[i] = pos.max() + 1
+    return position_ids, deltas
+
+
+def _mrope_tables(cfg: Qwen2VLConfig, position_ids):
+    """position_ids [3, B, T] → per-token (cos, sin) [B, T, head_dim/2]
+    with the channel range split across the t/h/w axes (mrope_section)."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / cfg.rope_theta ** (
+        jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim
+    )
+    # [3, B, T, half]
+    freqs = position_ids.astype(jnp.float32)[..., None] * inv_freq
+    sections = np.cumsum(cfg.mrope_section)[:-1]
+    parts = jnp.split(freqs, sections, axis=-1)
+    combined = jnp.concatenate(
+        [part[i % 3] for i, part in enumerate(parts)], axis=-1
+    )  # [B, T, half]
+    assert combined.shape[-1] == half
+    return jnp.cos(combined), jnp.sin(combined)
+
+
+def _lm(params, cfg: Qwen2VLConfig, h, cos, sin, mask, caches=None,
+        cache_index=None):
+    new_caches = {}
+    for i in range(cfg.layers):
+        block = params["blocks"][str(i)]
+        h, new_cache = L.block_forward(
+            block, h, cfg.heads, n_kv_heads=cfg.kv_heads,
+            rope_tables=(cos, sin), mask=mask,
+            cache=None if caches is None else caches[str(i)],
+            cache_index=cache_index, norm_eps=cfg.norm_eps,
+        )
+        if new_cache is not None:
+            new_caches[str(i)] = new_cache
+    return L.rms_norm(h, params["out_norm"], cfg.norm_eps), new_caches
+
+
+def _head(params, cfg: Qwen2VLConfig, dtype):
+    if cfg.tie_embeddings or "lm_head" not in params:
+        return params["embed"].astype(dtype).T
+    return params["lm_head"].astype(dtype)
+
+
+def _embed_with_images(params, cfg: Qwen2VLConfig, input_ids, image_feats, dtype):
+    """Token embeddings with image features scattered over <|image_pad|>
+    positions (in order)."""
+    h = params["embed"].astype(dtype)[input_ids]  # [B, T, dim]
+    if image_feats is None:
+        return h
+    is_image = input_ids == cfg.image_token_id  # [B, T]
+    order = jnp.cumsum(is_image.reshape(-1)) - 1  # flat index into feats
+    feats = image_feats.astype(dtype)[
+        jnp.clip(order, 0, image_feats.shape[0] - 1)
+    ].reshape(h.shape)
+    return jnp.where(is_image[..., None], feats, h)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(1,))
+def forward(params, cfg: Qwen2VLConfig, input_ids, image_feats, position_ids):
+    """Teacher-forced logits [B, T, vocab] float32. ``image_feats`` may be
+    None (text-only); ``position_ids`` [3, B, T] from :func:`rope_index`."""
+    dtype = L.compute_dtype()
+    h = _embed_with_images(params, cfg, input_ids, image_feats, dtype)
+    cos, sin = _mrope_tables(cfg, position_ids)
+    t = input_ids.shape[1]
+    mask = L.causal_mask(t, t)
+    h, _ = _lm(params, cfg, h, cos, sin, mask)
+    return (h @ _head(params, cfg, dtype)).astype(jnp.float32)
+
+
+def init_cache(cfg: Qwen2VLConfig, batch: int, dtype=None):
+    dtype = dtype or L.compute_dtype()
+    return {
+        str(i): {
+            "k": jnp.zeros((batch, cfg.kv_heads, cfg.max_seq, cfg.head_dim), dtype),
+            "v": jnp.zeros((batch, cfg.kv_heads, cfg.max_seq, cfg.head_dim), dtype),
+        }
+        for i in range(cfg.layers)
+    }
+
+
+@partial(jax.jit, static_argnums=(1, 5))
+def _generate_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
+                  position_ids, max_new_tokens, delta):
+    dtype = L.compute_dtype()
+    b, t = input_ids.shape
+    head = _head(params, cfg, dtype)
+
+    h = _embed_with_images(params, cfg, input_ids, image_feats, dtype)
+    cos, sin = _mrope_tables(cfg, position_ids)
+    mask = L.causal_mask(t, cfg.max_seq) & (
+        jnp.arange(cfg.max_seq)[None, None, None, :] < t
+    )
+    caches = init_cache(cfg, b)
+    h, caches = _lm(params, cfg, h, cos, sin, mask, caches=caches, cache_index=0)
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    def step(carry, i):
+        token, caches = carry
+        # Text continuation: all three rope axes share the same position.
+        rope_pos = (delta + i)[:, None]  # [B, 1]
+        pos3 = jnp.broadcast_to(rope_pos[None], (3, b, 1))
+        cos, sin = _mrope_tables(cfg, pos3)
+        cache_index = t + i
+        h = params["embed"].astype(dtype)[token][:, None, :]
+        mask = (jnp.arange(cfg.max_seq) <= cache_index)[None, None, None, :]
+        h, caches = _lm(
+            params, cfg, h, cos, sin, mask, caches=caches, cache_index=cache_index
+        )
+        nxt = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+            jnp.int32
+        )
+        return (nxt, caches), token
+
+    (_, _), tokens = jax.lax.scan(
+        step, (first, caches), jnp.arange(max_new_tokens)
+    )
+    return tokens.T
+
+
+# ---------------------------------------------------------------------------
+# in-graph image preprocessing + serving step (TPU-tier operator path)
+# ---------------------------------------------------------------------------
+
+OPENAI_CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+OPENAI_CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def smart_resize(
+    height: int, width: int, factor: int = 28,
+    min_pixels: int = 56 * 56, max_pixels: int = 14 * 14 * 4 * 1280,
+) -> tuple[int, int]:
+    """Target (h, w): divisible by ``factor``, pixel count within bounds,
+    aspect ratio preserved (mirrors the HF image processor)."""
+    import math
+
+    h_bar = max(factor, round(height / factor) * factor)
+    w_bar = max(factor, round(width / factor) * factor)
+    if h_bar * w_bar > max_pixels:
+        beta = math.sqrt((height * width) / max_pixels)
+        h_bar = max(factor, math.floor(height / beta / factor) * factor)
+        w_bar = max(factor, math.floor(width / beta / factor) * factor)
+    elif h_bar * w_bar < min_pixels:
+        beta = math.sqrt(min_pixels / (height * width))
+        h_bar = math.ceil(height * beta / factor) * factor
+        w_bar = math.ceil(width * beta / factor) * factor
+    return h_bar, w_bar
+
+
+def preprocess_image(image, cfg: VisionConfig, target_h: int, target_w: int):
+    """[H, W, 3] frame (uint8 or float) → flattened patches
+    [gh*gw, C*tp*ps*ps] in the HF processor's window-major layout.
+    Fully traceable — runs in-graph in the TPU operator tier."""
+    x = image.astype(jnp.float32)
+    if image.dtype == jnp.uint8:
+        x = x / 255.0
+    if x.shape[:2] != (target_h, target_w):
+        x = jax.image.resize(x, (target_h, target_w, 3), method="bilinear")
+    mean = jnp.asarray(OPENAI_CLIP_MEAN, jnp.float32)
+    std = jnp.asarray(OPENAI_CLIP_STD, jnp.float32)
+    x = (x - mean) / std
+    x = x.transpose(2, 0, 1)  # [C, H, W]
+    # Temporal tiling (an image repeats over the 2-frame temporal patch),
+    # then the processor's window-major reshape.
+    tp, ps, merge = cfg.temporal_patch_size, cfg.patch_size, cfg.spatial_merge_size
+    c = x.shape[0]
+    gh, gw = target_h // ps, target_w // ps
+    x = jnp.broadcast_to(x[None], (tp, c, target_h, target_w))
+    x = x.reshape(1, tp, c, gh // merge, merge, ps, gw // merge, merge, ps)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return x.reshape(gh * gw, c * tp * ps * ps)
+
+
+def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
+                      target_h: int, target_w: int, max_new_tokens: int):
+    """Build a fully-traced ``(params, image) -> tokens`` function with a
+    static prompt and image geometry — the shape the TPU operator tier
+    wants (one XLA program per tick, weights resident in HBM).
+
+    ``prompt_ids`` must already contain the ``<|image_pad|>`` run matching
+    the image's merged-patch count (use :func:`build_prompt_ids`).
+    """
+    ps = cfg.vision.patch_size
+    grid_thw = np.array([[1, target_h // ps, target_w // ps]])
+    freqs = vision_rotary(cfg.vision, grid_thw)
+    cos = jnp.asarray(np.cos(freqs))
+    sin = jnp.asarray(np.sin(freqs))
+    position_ids, deltas = rope_index(cfg, prompt_ids, grid_thw)
+    if prompt_ids.shape[1] + max_new_tokens > cfg.max_seq:
+        raise ValueError("prompt + max_new_tokens exceeds max_seq")
+    prompt = jnp.asarray(prompt_ids, jnp.int32)
+    position_ids = jnp.asarray(position_ids)
+    deltas = jnp.asarray(deltas)
+
+    def step_fn(params, image):
+        patches = preprocess_image(image, cfg.vision, target_h, target_w)
+        feats = _vision_forward(params, cfg.vision, patches, cos, sin, None)
+        return _generate_jit(
+            params, cfg, prompt, feats, position_ids, max_new_tokens, deltas
+        )
+
+    return step_fn
+
+
+def build_prompt_ids(cfg: Qwen2VLConfig, text_ids: list[int],
+                     target_h: int, target_w: int) -> np.ndarray:
+    """Prompt ids with the image placeholder run sized for the given
+    geometry: <|vision_start|> <|image_pad|>*N <|vision_end|> <text ids>
+    — the image-region format every Qwen2-VL checkpoint was trained on."""
+    ps, merge = cfg.vision.patch_size, cfg.vision.spatial_merge_size
+    n_merged = (target_h // ps) * (target_w // ps) // (merge * merge)
+    ids = (
+        [cfg.vision_start_token_id]
+        + [cfg.image_token_id] * n_merged
+        + [cfg.vision_end_token_id]
+        + list(text_ids)
+    )
+    return np.asarray([ids], dtype=np.int64)
+
+
+def generate(params, cfg: Qwen2VLConfig, input_ids, pixel_values, grid_thw,
+             max_new_tokens: int):
+    """Greedy generation: prompt ids [B, T] with <|image_pad|> runs +
+    flattened patches → [B, max_new_tokens] int32."""
+    input_ids = np.asarray(input_ids)
+    t = input_ids.shape[1]
+    if t + max_new_tokens > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq ({cfg.max_seq}); reload with a larger max_seq"
+        )
+    feats = None
+    if pixel_values is not None:
+        feats = encode_images(params, cfg, pixel_values, grid_thw)
+    position_ids, deltas = rope_index(
+        cfg, input_ids, grid_thw if pixel_values is not None else None
+    )
+    return _generate_jit(
+        params, cfg, jnp.asarray(input_ids), feats,
+        jnp.asarray(position_ids), max_new_tokens, jnp.asarray(deltas),
+    )

@@ -1,0 +1,440 @@
+"""Whisper-family ASR serving pretrained HF checkpoints.
+
+Faithful to transformers' `WhisperForConditionalGeneration` compute graph
+(LayerNorm pre-norm, learned/sinusoidal positions, biased projections with
+bias-free k, GELU MLP, tied output head) so real distil-whisper /
+whisper-large checkpoints produce the same logits — asserted numerically
+in tests/test_hf_parity.py. Reference: node-hub/dora-distil-whisper/
+dora_distil_whisper/main.py:20-40 (torch pipeline). Here encode and the
+greedy decode loop jit into XLA programs with a static KV cache.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.hf.loader import (
+    linear,
+    maybe_bias,
+    read_config,
+    read_safetensors,
+)
+
+
+@dataclass(frozen=True)
+class WhisperConfig:
+    vocab: int
+    dim: int
+    enc_layers: int
+    dec_layers: int
+    heads: int
+    dec_heads: int
+    ffn: int
+    n_mels: int
+    max_source: int  # encoder positions (frames/2)
+    max_target: int  # decoder positions
+    decoder_start_token: int
+    eos_token: int
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    @property
+    def dec_head_dim(self) -> int:
+        return self.dim // self.dec_heads
+
+    @classmethod
+    def from_hf(cls, config: dict) -> "WhisperConfig":
+        return cls(
+            vocab=config["vocab_size"],
+            dim=config["d_model"],
+            enc_layers=config["encoder_layers"],
+            dec_layers=config["decoder_layers"],
+            heads=config["encoder_attention_heads"],
+            dec_heads=config.get(
+                "decoder_attention_heads", config["encoder_attention_heads"]
+            ),
+            ffn=config["encoder_ffn_dim"],
+            n_mels=config["num_mel_bins"],
+            max_source=config["max_source_positions"],
+            max_target=config["max_target_positions"],
+            decoder_start_token=config.get("decoder_start_token_id", 50258),
+            eos_token=config.get("eos_token_id", 50257),
+        )
+
+
+def load(model_dir: str | Path):
+    """(config, params) from a HF checkpoint directory."""
+    cfg = WhisperConfig.from_hf(read_config(model_dir))
+    tensors = read_safetensors(model_dir)
+    return cfg, map_params(tensors, cfg)
+
+
+def _attn_params(tensors: dict, prefix: str) -> dict:
+    p: dict[str, Any] = {
+        "wq": linear(tensors, prefix + "q_proj.weight"),
+        "wk": linear(tensors, prefix + "k_proj.weight"),
+        "wv": linear(tensors, prefix + "v_proj.weight"),
+        "wo": linear(tensors, prefix + "out_proj.weight"),
+    }
+    maybe_bias(p, "bq", tensors, prefix + "q_proj.bias")
+    maybe_bias(p, "bk", tensors, prefix + "k_proj.bias")  # absent in whisper
+    maybe_bias(p, "bv", tensors, prefix + "v_proj.bias")
+    maybe_bias(p, "bo", tensors, prefix + "out_proj.bias")
+    return p
+
+
+def map_params(tensors: dict, cfg: WhisperConfig) -> dict:
+    prefix = "model." if any(k.startswith("model.") for k in tensors) else ""
+
+    def enc_layer(i: int) -> dict:
+        lp = f"{prefix}encoder.layers.{i}."
+        return {
+            "attn_norm": tensors[lp + "self_attn_layer_norm.weight"],
+            "attn_norm_b": tensors[lp + "self_attn_layer_norm.bias"],
+            **_attn_params(tensors, lp + "self_attn."),
+            "ffn_norm": tensors[lp + "final_layer_norm.weight"],
+            "ffn_norm_b": tensors[lp + "final_layer_norm.bias"],
+            "w_up": linear(tensors, lp + "fc1.weight"),
+            "b_up": tensors[lp + "fc1.bias"],
+            "w_down": linear(tensors, lp + "fc2.weight"),
+            "b_down": tensors[lp + "fc2.bias"],
+        }
+
+    def dec_layer(i: int) -> dict:
+        lp = f"{prefix}decoder.layers.{i}."
+        block = {
+            "attn_norm": tensors[lp + "self_attn_layer_norm.weight"],
+            "attn_norm_b": tensors[lp + "self_attn_layer_norm.bias"],
+            **_attn_params(tensors, lp + "self_attn."),
+            "ffn_norm": tensors[lp + "final_layer_norm.weight"],
+            "ffn_norm_b": tensors[lp + "final_layer_norm.bias"],
+            "w_up": linear(tensors, lp + "fc1.weight"),
+            "b_up": tensors[lp + "fc1.bias"],
+            "w_down": linear(tensors, lp + "fc2.weight"),
+            "b_down": tensors[lp + "fc2.bias"],
+            "cross": {
+                "norm": tensors[lp + "encoder_attn_layer_norm.weight"],
+                "norm_b": tensors[lp + "encoder_attn_layer_norm.bias"],
+                **_attn_params(tensors, lp + "encoder_attn."),
+            },
+        }
+        return block
+
+    params: dict[str, Any] = {
+        "conv1": np.ascontiguousarray(
+            tensors[f"{prefix}encoder.conv1.weight"].transpose(2, 1, 0)
+        ),  # [out,in,k] -> [k,in,out] (LIO)
+        "conv1_b": tensors[f"{prefix}encoder.conv1.bias"],
+        "conv2": np.ascontiguousarray(
+            tensors[f"{prefix}encoder.conv2.weight"].transpose(2, 1, 0)
+        ),
+        "conv2_b": tensors[f"{prefix}encoder.conv2.bias"],
+        "enc_pos": tensors[f"{prefix}encoder.embed_positions.weight"],
+        "enc_blocks": {str(i): enc_layer(i) for i in range(cfg.enc_layers)},
+        "enc_norm": tensors[f"{prefix}encoder.layer_norm.weight"],
+        "enc_norm_b": tensors[f"{prefix}encoder.layer_norm.bias"],
+        "embed": tensors[f"{prefix}decoder.embed_tokens.weight"],
+        "dec_pos": tensors[f"{prefix}decoder.embed_positions.weight"],
+        "dec_blocks": {str(i): dec_layer(i) for i in range(cfg.dec_layers)},
+        "dec_norm": tensors[f"{prefix}decoder.layer_norm.weight"],
+        "dec_norm_b": tensors[f"{prefix}decoder.layer_norm.bias"],
+    }
+    return jax.tree.map(jnp.asarray, params)
+
+
+# ---------------------------------------------------------------------------
+# log-mel frontend (matches WhisperFeatureExtractor: slaney-scale mel
+# filterbank, hann window, log10, max-8 clamp, (x+4)/4 normalization)
+# ---------------------------------------------------------------------------
+
+
+def slaney_mel_filters(
+    n_freqs: int, n_mels: int, sample_rate: int = 16000, n_fft: int = 400
+) -> np.ndarray:
+    """[n_freqs, n_mels] slaney-normalized triangular filters (float32)."""
+
+    def hz_to_mel(f):
+        f = np.asarray(f, dtype=np.float64)
+        mels = 3.0 * f / 200.0
+        log_region = f >= 1000.0
+        mels = np.where(
+            log_region, 15.0 + np.log(np.maximum(f, 1e-10) / 1000.0) / (np.log(6.4) / 27.0), mels
+        )
+        return mels
+
+    def mel_to_hz(m):
+        m = np.asarray(m, dtype=np.float64)
+        f = 200.0 * m / 3.0
+        log_region = m >= 15.0
+        f = np.where(log_region, 1000.0 * np.exp((np.log(6.4) / 27.0) * (m - 15.0)), f)
+        return f
+
+    fft_freqs = np.linspace(0, sample_rate / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(0.0), hz_to_mel(sample_rate / 2.0), n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    fdiff = np.diff(hz_pts)
+    ramps = hz_pts[:, None] - fft_freqs[None, :]
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        fb[i] = np.maximum(0, np.minimum(lower, upper))
+    enorm = 2.0 / (hz_pts[2 : n_mels + 2] - hz_pts[:n_mels])
+    fb *= enorm[:, None]
+    return fb.T.astype(np.float32)  # [n_freqs, n_mels]
+
+
+def log_mel_features(
+    audio: np.ndarray, n_mels: int, n_fft: int = 400, hop: int = 160,
+    n_samples: int = 480000,
+) -> np.ndarray:
+    """audio [B, samples] float32 → input_features [B, n_mels, 3000],
+    matching WhisperFeatureExtractor (pad/trim to 30 s, reflect-padded
+    STFT, slaney mel, log10, dynamic-range clamp, (x+4)/4)."""
+    b, n = audio.shape
+    if n < n_samples:
+        audio = np.pad(audio, ((0, 0), (0, n_samples - n)))
+    audio = audio[:, :n_samples]
+    pad = n_fft // 2
+    audio = np.pad(audio, ((0, 0), (pad, pad)), mode="reflect")
+    frames = 1 + (audio.shape[1] - n_fft) // hop
+    idx = np.arange(n_fft)[None, :] + hop * np.arange(frames)[:, None]
+    framed = audio[:, idx]  # [B, frames, n_fft]
+    window = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+    spec = np.abs(np.fft.rfft(framed * window, axis=-1)) ** 2  # [B, F, n_freq]
+    mel = spec @ slaney_mel_filters(n_fft // 2 + 1, n_mels, n_fft=n_fft)
+    log_spec = np.log10(np.maximum(mel, 1e-10))[:, :-1]  # drop last frame
+    log_spec = np.maximum(
+        log_spec, log_spec.max(axis=(1, 2), keepdims=True) - 8.0
+    )
+    log_spec = (log_spec + 4.0) / 4.0
+    return log_spec.transpose(0, 2, 1).astype(np.float32)  # [B, n_mels, T]
+
+
+# ---------------------------------------------------------------------------
+# encoder / decoder
+# ---------------------------------------------------------------------------
+
+
+def _conv1d(x, w, b, stride):
+    out = jax.lax.conv_general_dilated(
+        x, w, (stride,), [(1, 1)], dimension_numbers=("NLC", "LIO", "NLC")
+    )
+    return out + b
+
+
+@partial(jax.jit, static_argnums=(1,))
+def encode(params, cfg: WhisperConfig, input_features):
+    """input_features [B, n_mels, T] → [B, T/2, dim]."""
+    dtype = L.compute_dtype()
+    x = input_features.astype(dtype).transpose(0, 2, 1)  # [B, T, n_mels]
+    x = jax.nn.gelu(
+        _conv1d(x, params["conv1"].astype(dtype), params["conv1_b"].astype(dtype), 1),
+        approximate=False,
+    )
+    x = jax.nn.gelu(
+        _conv1d(x, params["conv2"].astype(dtype), params["conv2_b"].astype(dtype), 2),
+        approximate=False,
+    )
+    x = x + params["enc_pos"].astype(dtype)[None, : x.shape[1]]
+    for i in range(cfg.enc_layers):
+        x, _ = L.block_forward(
+            params["enc_blocks"][str(i)], x, cfg.heads, norm="ln", mlp="gelu",
+            norm_eps=1e-5,
+        )
+    return L.layer_norm(x, params["enc_norm"], params["enc_norm_b"])
+
+
+def _cross_attend(block, h, kv, n_heads):
+    cross = block["cross"]
+    b, t, dim = h.shape
+    head_dim = dim // n_heads
+    dtype = h.dtype
+    q = L.layer_norm(h, cross["norm"], cross["norm_b"])
+    q = L.dense(q, cross, "wq", "bq").reshape(b, t, n_heads, head_dim)
+    q = q.transpose(0, 2, 1, 3)
+    out = L.attention(q, kv[0], kv[1])
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, dim)
+    return h + L.dense(out, cross, "wo", "bo")
+
+
+def encoder_kv(params, cfg: WhisperConfig, enc):
+    """Precompute cross-attention K/V once per utterance."""
+    dtype = enc.dtype
+    b, s, dim = enc.shape
+    kv = {}
+    for i in range(cfg.dec_layers):
+        cross = params["dec_blocks"][str(i)]["cross"]
+        k = L.dense(enc, cross, "wk", "bk").reshape(
+            b, s, cfg.dec_heads, cfg.dec_head_dim
+        )
+        v = L.dense(enc, cross, "wv", "bv").reshape(
+            b, s, cfg.dec_heads, cfg.dec_head_dim
+        )
+        kv[str(i)] = (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+    return kv
+
+
+def _decoder(params, cfg: WhisperConfig, h, kv, mask, caches=None, cache_index=None):
+    new_caches = {}
+    for i in range(cfg.dec_layers):
+        block = params["dec_blocks"][str(i)]
+        # HF layer order: self-attn -> cross-attn -> feed-forward.
+        h, new_cache = L.attention_sublayer(
+            block, h, cfg.dec_heads, mask=mask, norm="ln", norm_eps=1e-5,
+            cache=None if caches is None else caches[str(i)],
+            cache_index=cache_index,
+        )
+        if new_cache is not None:
+            new_caches[str(i)] = new_cache
+        h = _cross_attend(block, h, kv[str(i)], cfg.dec_heads)
+        h = L.mlp_sublayer(block, h, norm="ln", mlp="gelu", norm_eps=1e-5)
+    return L.layer_norm(h, params["dec_norm"], params["dec_norm_b"]), new_caches
+
+
+@partial(jax.jit, static_argnums=(1,))
+def decoder_logits(params, cfg: WhisperConfig, enc, tokens):
+    """Full-sequence decoder (teacher-forced): tokens [B, T] →
+    logits [B, T, vocab] float32."""
+    dtype = L.compute_dtype()
+    b, t = tokens.shape
+    h = params["embed"].astype(dtype)[tokens]
+    h = h + params["dec_pos"].astype(dtype)[None, :t]
+    kv = encoder_kv(params, cfg, enc.astype(dtype))
+    mask = L.causal_mask(t, t)
+    h, _ = _decoder(params, cfg, h, kv, mask)
+    return (h @ params["embed"].astype(dtype).T).astype(jnp.float32)
+
+
+def _dec_cache(cfg: WhisperConfig, b, dtype):
+    return {
+        str(i): {
+            "k": jnp.zeros(
+                (b, cfg.dec_heads, cfg.max_target, cfg.dec_head_dim), dtype
+            ),
+            "v": jnp.zeros(
+                (b, cfg.dec_heads, cfg.max_target, cfg.dec_head_dim), dtype
+            ),
+        }
+        for i in range(cfg.dec_layers)
+    }
+
+
+@partial(jax.jit, static_argnums=(1, 3))
+def transcribe_tokens(params, cfg: WhisperConfig, input_features, max_new: int,
+                      forced_tokens=None):
+    """Greedy decode: input_features [B, n_mels, T] → tokens [B, max_new].
+
+    ``forced_tokens`` ([B, F] int32, e.g. start/language/task ids) seed the
+    decoder; defaults to the config's decoder_start_token.
+    """
+    dtype = L.compute_dtype()
+    enc = encode(params, cfg, input_features).astype(dtype)
+    kv = encoder_kv(params, cfg, enc)
+    b = input_features.shape[0]
+    if forced_tokens is None:
+        forced_tokens = jnp.full((b, 1), cfg.decoder_start_token, jnp.int32)
+    f = forced_tokens.shape[1]
+    if f + max_new > cfg.max_target:
+        # XLA would silently clamp out-of-bounds cache/position indices.
+        raise ValueError(
+            f"forced prefix ({f}) + max_new ({max_new}) exceeds the "
+            f"decoder's max_target_positions ({cfg.max_target})"
+        )
+
+    # Prefill with the forced prefix.
+    h = params["embed"].astype(dtype)[forced_tokens]
+    h = h + params["dec_pos"].astype(dtype)[None, :f]
+    mask = L.causal_mask(f, cfg.max_target) & (
+        jnp.arange(cfg.max_target)[None, None, None, :] < f
+    )
+    caches = _dec_cache(cfg, b, dtype)
+    h, caches = _decoder(params, cfg, h, kv, mask, caches=caches, cache_index=0)
+    head = params["embed"].astype(dtype).T
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    def step(carry, _):
+        token, caches, pos = carry
+        h = params["embed"].astype(dtype)[token][:, None, :]
+        h = h + params["dec_pos"].astype(dtype)[pos][None, None]
+        mask = (jnp.arange(cfg.max_target) <= pos)[None, None, None, :]
+        h, caches = _decoder(params, cfg, h, kv, mask, caches=caches, cache_index=pos)
+        nxt = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+            jnp.int32
+        )
+        return (nxt, caches, pos + 1), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (first, caches, jnp.asarray(f, jnp.int32)), None, length=max_new
+    )
+    return tokens.T
+
+
+def log_mel_traced(audio, n_mels: int, n_fft: int = 400, hop: int = 160,
+                   n_samples: int = 480000):
+    """Traceable counterpart of :func:`log_mel_features` — audio
+    [B, samples] → input_features [B, n_mels, 3000] inside the XLA
+    program (the mel filterbank matrix is a compile-time constant)."""
+    b, n = audio.shape
+    if n < n_samples:
+        audio = jnp.pad(audio, ((0, 0), (0, n_samples - n)))
+    audio = audio[:, :n_samples]
+    pad = n_fft // 2
+    audio = jnp.pad(audio, ((0, 0), (pad, pad)), mode="reflect")
+    frames = 1 + (audio.shape[1] - n_fft) // hop
+    idx = jnp.arange(n_fft)[None, :] + hop * jnp.arange(frames)[:, None]
+    framed = audio[:, idx]
+    window = jnp.asarray(np.hanning(n_fft + 1)[:-1], jnp.float32)
+    spec = jnp.abs(jnp.fft.rfft(framed * window, axis=-1)) ** 2
+    fb = jnp.asarray(slaney_mel_filters(n_fft // 2 + 1, n_mels, n_fft=n_fft))
+    mel = spec @ fb
+    log_spec = jnp.log10(jnp.maximum(mel, 1e-10))[:, :-1]
+    log_spec = jnp.maximum(
+        log_spec, jnp.max(log_spec, axis=(1, 2), keepdims=True) - 8.0
+    )
+    log_spec = (log_spec + 4.0) / 4.0
+    return log_spec.transpose(0, 2, 1)
+
+
+def make_serving_step(cfg: WhisperConfig, max_new_tokens: int,
+                      forced_tokens: np.ndarray | None = None):
+    """Build a fully-traced ``(params, audio[samples]) -> tokens`` function
+    (mel → encoder → greedy decode as one XLA program per utterance)."""
+    forced = None if forced_tokens is None else jnp.asarray(
+        forced_tokens, jnp.int32
+    )
+    # The encoder consumes exactly 2*max_source frames (hop 160).
+    n_samples = cfg.max_source * 2 * 160
+
+    def step_fn(params, audio):
+        feats = log_mel_traced(
+            audio[None].astype(jnp.float32), cfg.n_mels, n_samples=n_samples
+        )
+        return transcribe_tokens(params, cfg, feats, max_new_tokens, forced)
+
+    return step_fn
+
+
+def trim_after_eos(tokens: np.ndarray, eos: int) -> list[list[int]]:
+    """Cut each row at the first EOS (host-side postprocess)."""
+    out = []
+    for row in np.asarray(tokens):
+        ids = []
+        for t in row.tolist():
+            if t == eos:
+                break
+            ids.append(t)
+        out.append(ids)
+    return out

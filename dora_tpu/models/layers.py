@@ -46,6 +46,33 @@ def rms_norm(x, weight, eps: float = 1e-6):
     return (x * weight).astype(dtype)
 
 
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * weight + bias).astype(dtype)
+
+
+def _norm(x, params, prefix: str, kind: str, eps: float):
+    """Apply the block's pre-norm: RMSNorm (weight only) or LayerNorm
+    (weight + ``<prefix>_b`` bias) — the two conventions pretrained
+    checkpoints use (Qwen2/Llama vs ViT/Whisper)."""
+    if kind == "ln":
+        return layer_norm(x, params[prefix], params[prefix + "_b"], eps)
+    return rms_norm(x, params[prefix], eps)
+
+
+def dense(x, params, w: str, b: str):
+    """x @ params[w] (+ params[b] when the checkpoint has the bias)."""
+    out = x @ params[w].astype(x.dtype)
+    bias = params.get(b)
+    if bias is not None:
+        out = out + bias.astype(x.dtype)
+    return out
+
+
 def rope_table(max_len: int, head_dim: int, base: float = 10000.0):
     """(cos, sin) tables [max_len, head_dim/2] in float32."""
     inv_freq = 1.0 / base ** (
@@ -58,8 +85,17 @@ def rope_table(max_len: int, head_dim: int, base: float = 10000.0):
 
 def apply_rope(x, cos, sin, positions):
     """x: [B, H, T, D]; positions: [B, T] absolute token positions."""
-    cos = cos[positions][:, None, :, :]  # [B,1,T,D/2]
-    sin = sin[positions][:, None, :, :]
+    return apply_rope_tables(x, cos[positions], sin[positions])
+
+
+def apply_rope_tables(x, cos, sin):
+    """Rotary with per-token half-dim tables ([T, D/2] or [B, T, D/2]),
+    NeoX split convention. x: [B, H, T, D]."""
+    if cos.ndim == 2:
+        cos = cos[None]
+        sin = sin[None]
+    cos = cos[:, None].astype(jnp.float32)  # [B,1,T,D/2]
+    sin = sin[:, None].astype(jnp.float32)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
@@ -112,27 +148,59 @@ def block_forward(
     n_kv_heads: int | None = None,
     rope: tuple | None = None,
     positions=None,
+    rope_tables: tuple | None = None,
     mask=None,
     cache: dict | None = None,
     cache_index=None,
     mesh=None,
     ring_axis: str | None = None,
+    norm: str = "rms",
+    mlp: str = "swiglu",
+    norm_eps: float = 1e-6,
+    head_dim: int | None = None,
 ):
     """One pre-norm block. Returns (y, new_cache).
 
     With ``cache`` (decode): k/v are written at ``cache_index`` and attention
     runs against the full cache. With ``ring_axis``: attention runs as ring
     attention over that mesh axis (training/prefill long-context path).
+
+    ``norm`` ("rms" | "ln"), ``mlp`` ("swiglu" | "gelu") and the optional
+    projection biases (``bq``/``bk``/``bv``/``bo``/``b_up``/``b_down``/
+    ``b_gate`` keys, applied when present) select between the layouts
+    pretrained checkpoints use: Qwen2/Llama = rms+swiglu (+qkv bias for
+    Qwen2), ViT/Whisper = ln+gelu with full biases.
+    """
+    x, new_cache = attention_sublayer(
+        params, x, n_heads, n_kv_heads=n_kv_heads, rope=rope,
+        positions=positions, rope_tables=rope_tables, mask=mask, cache=cache,
+        cache_index=cache_index, mesh=mesh, ring_axis=ring_axis, norm=norm,
+        norm_eps=norm_eps, head_dim=head_dim,
+    )
+    x = mlp_sublayer(params, x, norm=norm, mlp=mlp, norm_eps=norm_eps)
+    return x, new_cache
+
+
+def attention_sublayer(
+    params, x, n_heads, *, n_kv_heads=None, rope=None, positions=None,
+    rope_tables=None, mask=None, cache=None, cache_index=None, mesh=None,
+    ring_axis=None, norm="rms", norm_eps=1e-6, head_dim=None,
+):
+    """Pre-norm self-attention with residual. Returns (y, new_cache).
+
+    Rotary comes either as ``rope=(cos, sin)`` position-indexed tables (+
+    ``positions``), or as ``rope_tables=(cos, sin)`` per-token tables
+    ([B, T, D/2] — the M-RoPE / 2-D vision case).
     """
     b, t, dim = x.shape
     n_kv = n_kv_heads or n_heads
-    head_dim = dim // n_heads
+    head_dim = head_dim or dim // n_heads
     dtype = x.dtype
 
-    h = rms_norm(x, params["attn_norm"])
-    q = (h @ params["wq"].astype(dtype)).reshape(b, t, n_heads, head_dim)
-    k = (h @ params["wk"].astype(dtype)).reshape(b, t, n_kv, head_dim)
-    v = (h @ params["wv"].astype(dtype)).reshape(b, t, n_kv, head_dim)
+    h = _norm(x, params, "attn_norm", norm, norm_eps)
+    q = dense(h, params, "wq", "bq").reshape(b, t, n_heads, head_dim)
+    k = dense(h, params, "wk", "bk").reshape(b, t, n_kv, head_dim)
+    v = dense(h, params, "wv", "bv").reshape(b, t, n_kv, head_dim)
     q, k, v = (z.transpose(0, 2, 1, 3) for z in (q, k, v))  # [B,H,T,D]
 
     if rope is not None:
@@ -141,6 +209,10 @@ def block_forward(
             positions = jnp.broadcast_to(jnp.arange(t), (b, t))
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+    elif rope_tables is not None:
+        cos, sin = rope_tables
+        q = apply_rope_tables(q, cos, sin)
+        k = apply_rope_tables(k, cos, sin)
 
     new_cache = None
     if cache is not None:
@@ -165,13 +237,18 @@ def block_forward(
         out = attention(q, k.astype(dtype), v.astype(dtype), mask)
 
     out = out.transpose(0, 2, 1, 3).reshape(b, t, n_heads * head_dim)
-    x = x + out @ params["wo"].astype(dtype)
+    return x + dense(out, params, "wo", "bo"), new_cache
 
-    h = rms_norm(x, params["ffn_norm"])
-    gate = jax.nn.silu(h @ params["w_gate"].astype(dtype))
-    up = h @ params["w_up"].astype(dtype)
-    x = x + (gate * up) @ params["w_down"].astype(dtype)
-    return x, new_cache
+
+def mlp_sublayer(params, x, *, norm="rms", mlp="swiglu", norm_eps=1e-6):
+    """Pre-norm feed-forward with residual."""
+    h = _norm(x, params, "ffn_norm", norm, norm_eps)
+    if mlp == "gelu":
+        up = jax.nn.gelu(dense(h, params, "w_up", "b_up"), approximate=False)
+        return x + dense(up, params, "w_down", "b_down")
+    gate = jax.nn.silu(dense(h, params, "w_gate", "b_gate"))
+    up = dense(h, params, "w_up", "b_up")
+    return x + dense(gate * up, params, "w_down", "b_down")
 
 
 #: Tensor-parallel sharding rules for block parameters (Megatron layout):

@@ -68,11 +68,78 @@ def make_detector() -> JaxOperator:
     return JaxOperator(step=step, init_state=params)
 
 
+def _hf_checkpoint(model_type_prefix: str) -> str | None:
+    """Path from DORA_HF_CHECKPOINT when it holds a matching HF checkpoint
+    (reference nodes load checkpoints by name through transformers,
+    node-hub/dora-qwenvl/dora_qwenvl/main.py:24-33; here the path points
+    at a downloaded safetensors directory)."""
+    import json
+    from pathlib import Path
+
+    path = os.environ.get("DORA_HF_CHECKPOINT")
+    if not path:
+        return None
+    config = Path(path) / "config.json"
+    if not config.exists():
+        raise FileNotFoundError(f"DORA_HF_CHECKPOINT={path}: no config.json")
+    model_type = json.loads(config.read_text()).get("model_type", "")
+    return path if model_type.startswith(model_type_prefix) else None
+
+
+def _hf_tokenizer(path: str):
+    from pathlib import Path
+
+    from dora_tpu.models.tokenizer import BPETokenizer
+
+    if (Path(path) / "tokenizer.json").exists():
+        return BPETokenizer.from_file(path)
+    return None
+
+
 def make_vlm() -> JaxOperator:
-    """Image [H,W,3] -> greedy caption tokens (prompt from DORA_PROMPT)."""
+    """Image [H,W,3] -> greedy caption tokens (prompt from DORA_PROMPT).
+
+    With DORA_HF_CHECKPOINT pointing at a Qwen2-VL safetensors directory,
+    serves the real pretrained model (weights + BPE tokenizer); otherwise
+    the self-contained trainable VLM with the byte tokenizer.
+    """
     import jax.numpy as jnp
 
     from dora_tpu.models import tokenizer, vlm
+
+    hf_path = _hf_checkpoint("qwen2_vl")
+    if hf_path:
+        import numpy as np
+
+        from dora_tpu.models.hf import qwen2_vl
+
+        max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "16"))
+        height = int(os.environ.get("IMAGE_HEIGHT", "224"))
+        width = int(os.environ.get("IMAGE_WIDTH", "224"))
+        cfg, params = qwen2_vl.load(
+            hf_path, max_seq=int(os.environ.get("DORA_MAX_SEQ", "1024"))
+        )
+        tok = _hf_tokenizer(hf_path)
+        prompt_text = os.environ.get("DORA_PROMPT", "Describe this image.")
+        target_h, target_w = qwen2_vl.smart_resize(
+            height, width, factor=cfg.vision.patch_size * cfg.vision.spatial_merge_size
+        )
+        if tok is not None:
+            text_ids = tok.encode(prompt_text)
+        else:  # no tokenizer.json shipped: byte-fallback text encoding
+            text_ids = [t % cfg.vocab for t in tokenizer.encode(prompt_text)]
+        prompt_ids = qwen2_vl.build_prompt_ids(
+            cfg, text_ids, target_h, target_w
+        )
+        serve = qwen2_vl.make_serving_step(
+            cfg, prompt_ids, target_h, target_w, max_new
+        )
+
+        def hf_step(state, inputs):
+            tokens = serve(state, _normalize(inputs["image"]))
+            return state, {"tokens": tokens[0]}
+
+        return JaxOperator(step=hf_step, init_state=params)
 
     cfg = vlm.VLMConfig.tiny() if _size() == "tiny" else vlm.VLMConfig.bench_2b()
     params = _maybe_restore(vlm.init_params(jax.random.PRNGKey(0), cfg), "vlm")
@@ -91,8 +158,26 @@ def make_vlm() -> JaxOperator:
 
 
 def make_asr() -> JaxOperator:
-    """Audio chunk [samples] float -> token ids."""
+    """Audio chunk [samples] float -> token ids.
+
+    With DORA_HF_CHECKPOINT pointing at a Whisper-family safetensors
+    directory, serves the real pretrained model.
+    """
     from dora_tpu.models import asr, tokenizer
+
+    hf_path = _hf_checkpoint("whisper")
+    if hf_path:
+        from dora_tpu.models.hf import whisper
+
+        max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "32"))
+        cfg, params = whisper.load(hf_path)
+        serve = whisper.make_serving_step(cfg, max_new)
+
+        def hf_step(state, inputs):
+            tokens = serve(state, inputs["audio"])
+            return state, {"tokens": tokens[0]}
+
+        return JaxOperator(step=hf_step, init_state=params)
 
     cfg = asr.ASRConfig.tiny() if _size() == "tiny" else asr.ASRConfig()
     params = _maybe_restore(asr.init_params(jax.random.PRNGKey(0), cfg), "asr")
