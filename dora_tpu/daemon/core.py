@@ -111,6 +111,9 @@ class DataflowState:
     pending_nodes: set[str] = field(default_factory=set)
     started: asyncio.Event = field(default_factory=asyncio.Event)
     barrier_error: str | None = None
+    #: node whose pre-subscribe exit poisoned the barrier (structured
+    #: cascading-cause attribution; never recovered from the message text)
+    barrier_failed_node: str | None = None
     #: failure bookkeeping
     failed_nodes: list[str] = field(default_factory=list)
     grace_kills: set[str] = field(default_factory=set)
@@ -349,8 +352,14 @@ class Daemon:
                 else:
                     self._release_barrier(df)
 
-    def _release_barrier(self, df: DataflowState, error: str | None = None) -> None:
+    def _release_barrier(
+        self,
+        df: DataflowState,
+        error: str | None = None,
+        failed_node: str | None = None,
+    ) -> None:
         df.barrier_error = error
+        df.barrier_failed_node = failed_node
         df.started.set()
         if error is None:
             self._start_timers(df)
@@ -368,7 +377,9 @@ class Daemon:
         (reference: pending.rs:160-190)."""
         if not df.started.is_set():
             self._release_barrier(
-                df, error=f"node {failed_node!r} exited before subscribing"
+                df,
+                error=f"node {failed_node!r} exited before subscribing",
+                failed_node=failed_node,
             )
 
     # ------------------------------------------------------------------
@@ -623,12 +634,9 @@ class Daemon:
                 cause = NodeErrorCause(
                     kind="cascading", caused_by_node=df.failed_nodes[0]
                 )
-            elif df.barrier_error is not None and nid not in df.barrier_error:
+            elif df.barrier_error is not None and nid != df.barrier_failed_node:
                 cause = NodeErrorCause(
-                    kind="cascading",
-                    caused_by_node=df.barrier_error.split("'")[1]
-                    if "'" in df.barrier_error
-                    else None,
+                    kind="cascading", caused_by_node=df.barrier_failed_node
                 )
             else:
                 stderr = "\n".join(df.stderr_rings.get(nid, [])) or None

@@ -156,3 +156,66 @@ def test_stop_running_dataflow(tmp_path):
             await coord.close()
 
     asyncio.run(main())
+
+
+def test_cascading_cause_across_daemons(tmp_path):
+    """A node on machine A dies before subscribing; the barrier poison
+    propagates through the coordinator, and the innocent node on machine B
+    is classified ``cascading`` with the *structured* culprit id (no
+    message-text parsing)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    victim = tmp_path / "victim.py"
+    victim.write_text(
+        "from dora_tpu.node import Node\n"
+        "with Node() as node:\n"
+        "    for event in node:\n"
+        "        pass\n"
+    )
+    spec = {
+        "nodes": [
+            {
+                "id": "bad",
+                "path": "bad.py",
+                "outputs": ["data"],
+                "deploy": {"machine": "A"},
+            },
+            {
+                "id": "victim",
+                "path": "victim.py",
+                "inputs": {"in": "bad/data"},
+                "deploy": {"machine": "B"},
+            },
+        ]
+    }
+
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        addr = f"127.0.0.1:{coord.daemon_port}"
+        daemon_a, daemon_b = Daemon(), Daemon()
+        tasks = [
+            asyncio.create_task(daemon_a.run(addr, "A")),
+            asyncio.create_task(daemon_b.run(addr, "B")),
+        ]
+        try:
+            await _wait_machines(coord, {"A", "B"})
+            start = await coord.handle_control_request(
+                cm.Start(
+                    dataflow=spec, name=None, local_working_dir=str(tmp_path)
+                )
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+            result = await _wait_finished(coord, start.uuid)
+            assert not result.is_ok()
+            errors = dict(result.errors())
+            assert errors["bad"].cause.kind == "other"
+            assert errors["victim"].cause.kind == "cascading"
+            assert errors["victim"].cause.caused_by_node == "bad"
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            for t in tasks:
+                t.cancel()
+            await coord.close()
+
+    asyncio.run(main())
