@@ -9,6 +9,9 @@ MXU/HBM, SURVEY.md §2.4):
   * ``asr``       — Distil-Whisper-class speech recognition
                     (log-mel frontend + encoder-decoder transformer).
   * ``vad``       — Silero-class voice activity detection.
+  * ``translation`` — Opus-MT-class encoder-decoder translation.
+  * ``tts``       — Parler-class text-to-speech (non-autoregressive
+                    FastSpeech-style stack + transposed-conv vocoder).
 
 All models are pure-JAX (dict-pytree parameters, functional transforms):
 bfloat16 matmuls for the MXU, static shapes, `lax.scan` decode loops, and

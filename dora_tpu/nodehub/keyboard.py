@@ -1,0 +1,71 @@
+"""Keyboard character source.
+
+Reference parity: node-hub/dora-keyboard — emits one ``char`` output per
+key press (dora_keyboard/main.py:7-16, via pynput). Here the terminal
+itself is the keyboard: stdin is switched to cbreak mode and read one
+character at a time, so the node works over SSH and inside containers
+where an X11 event tap (pynput's backend) does not exist. Without a TTY
+(CI, piped stdin) it degrades to replaying ``KEYBOARD_SYNTHETIC`` so
+dataflows stay runnable anywhere.
+
+Env: ``KEYBOARD_SYNTHETIC`` — string replayed as key presses when stdin
+is not a terminal (default "hello"); ``MAX_CHARS`` — stop after N chars
+(0 = unlimited); ``CHAR_DELAY_MS`` — spacing of synthetic presses.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from dora_tpu.node import Node
+
+
+def _read_tty_chars(node: Node, max_chars: int) -> None:
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    sent = 0
+    try:
+        tty.setcbreak(fd)
+        while True:
+            ch = sys.stdin.read(1)
+            if not ch or ch == "\x04":  # EOF / ctrl-d
+                break
+            node.send_output("char", ch.encode())
+            sent += 1
+            if max_chars and sent >= max_chars:
+                break
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+def _replay_synthetic(node: Node, max_chars: int) -> None:
+    text = os.environ.get("KEYBOARD_SYNTHETIC", "hello")
+    delay = int(os.environ.get("CHAR_DELAY_MS", "10")) / 1000.0
+    for i, ch in enumerate(text):
+        if max_chars and i >= max_chars:
+            break
+        node.send_output("char", ch.encode())
+        time.sleep(delay)
+
+
+def main() -> None:
+    max_chars = int(os.environ.get("MAX_CHARS", "0"))
+    node_id = os.environ.get("NODE_ID")
+    daemon_addr = os.environ.get("DORA_DAEMON_ADDR")
+    node = Node(node_id=node_id, daemon_addr=daemon_addr) if node_id else Node()
+    try:
+        if sys.stdin.isatty():
+            _read_tty_chars(node, max_chars)
+        else:
+            _replay_synthetic(node, max_chars)
+    finally:
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -181,13 +181,81 @@ def make_asr() -> JaxOperator:
 
     cfg = asr.ASRConfig.tiny() if _size() == "tiny" else asr.ASRConfig()
     params = _maybe_restore(asr.init_params(jax.random.PRNGKey(0), cfg), "asr")
-    max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "16"))
+    max_new = min(
+        int(os.environ.get("DORA_MAX_NEW_TOKENS", "16")), cfg.max_tokens
+    )
     bos = tokenizer.BOS % cfg.vocab
 
     def step(state, inputs):
         audio = inputs["audio"][None]
         tokens = asr.transcribe(state, cfg, audio, bos, max_new)
         return state, {"tokens": tokens[0]}
+
+    return JaxOperator(step=step, init_state=params)
+
+
+def make_translator() -> JaxOperator:
+    """Text (utf-8 bytes or token ids) -> translated token ids.
+
+    Reference parity: node-hub/dora-opus / dora-argotranslate (text in,
+    translated text out through a pretrained encoder-decoder). Tokens ride
+    the byte-level codec (dora_tpu.models.tokenizer), so the emitted ids
+    decode back to text with ``tokenizer.decode``.
+    """
+    import jax.numpy as jnp
+
+    from dora_tpu.models import tokenizer, translation
+
+    cfg = (
+        translation.TranslatorConfig.tiny()
+        if _size() == "tiny"
+        else translation.TranslatorConfig()
+    )
+    params = _maybe_restore(
+        translation.init_params(jax.random.PRNGKey(0), cfg), "translator"
+    )
+    # Decode steps beyond the KV-cache capacity would silently clamp.
+    max_new = min(
+        int(os.environ.get("DORA_MAX_NEW_TOKENS", "16")), cfg.max_tokens
+    )
+    bos = tokenizer.BOS % cfg.vocab
+
+    def step(state, inputs):
+        src = inputs["text"].astype(jnp.int32) % cfg.vocab
+        # Static-shape source window: trim or right-pad to max_src (the
+        # pad id attends as ordinary context; real checkpoints mask it).
+        src = src[: cfg.max_src]
+        src = jnp.pad(src, (0, cfg.max_src - src.shape[0]),
+                      constant_values=tokenizer.PAD % cfg.vocab)
+        tokens = translation.translate(state, cfg, src[None], bos, max_new)
+        return state, {"tokens": tokens[0]}
+
+    return JaxOperator(step=step, init_state=params)
+
+
+def make_tts() -> JaxOperator:
+    """Text (utf-8 bytes / token ids) -> waveform samples.
+
+    Reference parity: node-hub/dora-parler (text in, speech out,
+    dora_parler/main.py:94-150). ``DORA_TTS_STYLE`` selects the voice
+    (the reference's description prompt); output is float32 in [-1, 1]
+    at ``cfg.sample_rate``.
+    """
+    import jax.numpy as jnp
+
+    from dora_tpu.models import tokenizer, tts
+
+    cfg = tts.TTSConfig.tiny() if _size() == "tiny" else tts.TTSConfig()
+    params = _maybe_restore(tts.init_params(jax.random.PRNGKey(0), cfg), "tts")
+    style = int(os.environ.get("DORA_TTS_STYLE", "0")) % cfg.n_styles
+
+    def step(state, inputs):
+        text = inputs["text"].astype(jnp.int32) % cfg.vocab
+        text = text[: cfg.max_text]
+        text = jnp.pad(text, (0, cfg.max_text - text.shape[0]),
+                       constant_values=tokenizer.PAD % cfg.vocab)
+        wave = tts.synthesize(state, cfg, text[None], jnp.asarray([style]))
+        return state, {"audio": wave[0]}
 
     return JaxOperator(step=step, init_state=params)
 

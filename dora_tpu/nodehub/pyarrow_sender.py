@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import ast
 import os
+import time
 
 import pyarrow as pa
 
@@ -17,8 +18,11 @@ from dora_tpu.node import Node
 def main() -> None:
     data = ast.literal_eval(os.environ.get("DATA", "[1, 2, 3]"))
     count = int(os.environ.get("COUNT", "1"))
+    delay = float(os.environ.get("DELAY", "0"))  # seconds before each send
     with Node() as node:
         for _ in range(count):
+            if delay:
+                time.sleep(delay)
             node.send_output("data", pa.array(data if isinstance(data, list) else [data]))
 
 

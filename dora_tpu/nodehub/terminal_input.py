@@ -1,0 +1,82 @@
+"""Interactive dynamic sender: type a value, it goes into the dataflow.
+
+Reference parity: node-hub/terminal-input — a *dynamic* node (``path:
+dynamic`` in the YAML) started by hand in a terminal; each line typed is
+parsed with ``ast.literal_eval`` (falling back to a string) and sent on
+the ``data`` output, and anything routed back to this node is printed
+(terminal_input/main.py:36-96). Non-interactive use: set ``DATA`` to send
+one value and exit — that is also the CI path.
+
+Connect it with ``NODE_ID`` (+ ``DORA_DAEMON_ADDR``) like every dynamic
+node; retries until the dataflow is up, as the reference does.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import time
+
+from dora_tpu.node import Node
+
+
+def parse_value(text: str):
+    """``ast.literal_eval`` with the reference's fall-back-to-string rule."""
+    try:
+        return ast.literal_eval(text)
+    except (ValueError, SyntaxError):
+        return text
+
+
+def _to_payload(value):
+    import pyarrow as pa
+
+    if isinstance(value, (list, tuple)):
+        return pa.array(list(value))
+    return pa.array([value])
+
+
+def _connect(node_id: str | None) -> Node:
+    daemon_addr = os.environ.get("DORA_DAEMON_ADDR")
+    last_err = ""
+    while True:
+        try:
+            if node_id:
+                return Node(node_id=node_id, daemon_addr=daemon_addr)
+            return Node()
+        except (OSError, RuntimeError) as err:  # dataflow not up yet
+            if str(err) != last_err:
+                print(err)
+                last_err = str(err)
+            print("Waiting for dataflow to be spawned", flush=True)
+            time.sleep(1)
+
+
+def main() -> None:
+    node_id = os.environ.get("NODE_ID")
+    data = os.environ.get("DATA")
+    node = _connect(node_id)
+    try:
+        if data is not None:
+            node.send_output("data", _to_payload(parse_value(data)))
+            return
+        while True:
+            try:
+                line = input("Provide the data you want to send:  ")
+            except EOFError:
+                break
+            node.send_output("data", _to_payload(parse_value(line)))
+            # Drain replies briefly so request/response demos read naturally.
+            while True:
+                event = node.next(timeout=0.2)
+                if event is not None and event["type"] == "INPUT":
+                    print(f"Received: {event['value']}", flush=True)
+                else:
+                    break
+    finally:
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Token-ids → text decoder node.
+
+The TPU-tier model operators emit token ids (device arrays); the
+reference's nodes emit ready-made strings because decoding happens inside
+their torch pipelines. This node is the boundary between the two worlds:
+it decodes each incoming id array to a string — with the BPE vocabulary
+from ``DORA_TOKENIZER`` (a directory or tokenizer.json) when given,
+byte-level codec otherwise — and re-emits it as a one-element string
+array, ready for sinks that expect text (rerun sink, llama recorder,
+openai server).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pyarrow as pa
+
+from dora_tpu.node import Node
+from dora_tpu.tpu.bridge import arrow_to_host
+
+
+def make_decoder():
+    path = os.environ.get("DORA_TOKENIZER")
+    if path:
+        from dora_tpu.models.tokenizer import BPETokenizer
+
+        tok = BPETokenizer.from_file(path)
+        return lambda ids: tok.decode([int(i) for i in ids])
+    from dora_tpu.models import tokenizer
+
+    return lambda ids: tokenizer.decode(ids)
+
+
+def main() -> None:
+    decode = make_decoder()
+    with Node() as node:
+        for event in node:
+            if event["type"] == "STOP":
+                break
+            if event["type"] != "INPUT":
+                continue
+            ids = arrow_to_host(event["value"], event["metadata"]).reshape(-1)
+            node.send_output("text", pa.array([decode(ids)]))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,15 @@ DTYPE_KEY = "dtype"
 
 def arrow_to_host(value: pa.Array, metadata: dict | None = None) -> np.ndarray:
     """Arrow array -> numpy (zero-copy when the type allows), reshaped per
-    the ``shape`` metadata."""
+    the ``shape`` metadata.
+
+    String arrays (e.g. from terminal-input / keyboard) become the utf-8
+    bytes of their joined entries, so text flows straight into byte-level
+    tokenizing operators as a uint8 array.
+    """
+    if pa.types.is_string(value.type) or pa.types.is_large_string(value.type):
+        text = " ".join(s for s in value.to_pylist() if s is not None)
+        return np.frombuffer(text.encode(), dtype=np.uint8).copy()
     try:
         arr = value.to_numpy(zero_copy_only=True)
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):

@@ -1,4 +1,4 @@
-"""Detection / ASR / VAD model tests (tiny configs, CPU)."""
+"""Detection / ASR / VAD / TTS model tests (tiny configs, CPU)."""
 
 from __future__ import annotations
 
@@ -8,7 +8,7 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp
 
-from dora_tpu.models import asr, detection, vad
+from dora_tpu.models import asr, detection, tts, vad
 
 
 class TestDetection:
@@ -102,3 +102,44 @@ class TestVAD:
         probs = np.array([0.9, 0.2, 0.9, 0.9, 0.1, 0.1, 0.8])
         mask = vad.segment_speech(probs, threshold=0.5)
         assert mask.tolist() == [True, True, True, True, False, False, True]
+
+
+class TestTTS:
+    CFG = tts.TTSConfig.tiny()
+
+    @pytest.fixture(scope="class")
+    def params(self):
+        return tts.init_params(jax.random.PRNGKey(0), self.CFG)
+
+    def test_synthesize_static_shapes(self, params):
+        cfg = self.CFG
+        text = jnp.zeros((2, cfg.max_text), jnp.int32)
+        wave = tts.synthesize(params, cfg, text, jnp.asarray([0, 1]))
+        assert wave.shape == (2, cfg.max_samples)
+        assert wave.dtype == jnp.float32
+        assert np.all(np.abs(np.asarray(wave)) <= 1.0)
+
+    def test_styles_differ(self, params):
+        cfg = self.CFG
+        text = jnp.ones((1, cfg.max_text), jnp.int32)
+        a = tts.synthesize(params, cfg, text, jnp.asarray([0]))
+        b = tts.synthesize(params, cfg, text, jnp.asarray([1]))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_vocoder_strides_factor_hop(self):
+        for hop in (16, 64, 128, 256, 200):
+            s1, s2, s3 = tts._vocoder_strides(hop)
+            assert s1 * s2 * s3 == hop
+
+    def test_loss_differentiable(self, params):
+        cfg = self.CFG
+        batch = {
+            "text": jnp.ones((1, cfg.max_text), jnp.int32),
+            "style": jnp.asarray([0]),
+            "mel": jnp.zeros((1, cfg.max_frames, cfg.n_mels)),
+            "wave": jnp.zeros((1, cfg.max_samples)),
+        }
+        loss, grads = jax.value_and_grad(tts.loss_fn)(params, cfg, batch)
+        assert np.isfinite(float(loss))
+        norms = [float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads)]
+        assert any(n > 0 for n in norms)
