@@ -102,6 +102,20 @@ def cmd_graph(args) -> int:
     return 0
 
 
+def cmd_schema(args) -> int:
+    """Emit the dataflow JSON schema (reference: generate_schema.rs)."""
+    import json
+
+    from dora_tpu.core.schema import descriptor_schema, generate_schema
+
+    if args.output:
+        out = generate_schema(args.output)
+        print(f"wrote {out}")
+    else:
+        print(json.dumps(descriptor_schema(), indent=2))
+    return 0
+
+
 def cmd_build(args) -> int:
     """Run each node's / operator's `build:` command (reference: build.rs)."""
     from dora_tpu.core.descriptor import CustomNode, RuntimeNode
@@ -417,6 +431,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("dataflow")
     p.add_argument("--mermaid", action="store_true", help="print mermaid source")
     p.set_defaults(fn=cmd_graph)
+
+    p = sub.add_parser(
+        "schema", help="emit the dataflow JSON schema (editor support)"
+    )
+    p.add_argument("-o", "--output", help="write to a file instead of stdout")
+    p.set_defaults(fn=cmd_schema)
 
     p = sub.add_parser("build", help="run the build commands of all nodes")
     p.add_argument("dataflow")

@@ -1,0 +1,251 @@
+"""JSON Schema for the dataflow descriptor (editor/IDE support).
+
+Reference parity: libraries/core/src/bin/generate_schema.rs derives
+``dora-schema.json`` from the Rust Descriptor types via schemars so YAML
+editors validate and autocomplete dataflows. Here the schema is authored
+against the same grammar the parser implements
+(dora_tpu.core.descriptor / dora_tpu.core.config) — the test suite keeps
+the two in lock-step by validating every example dataflow against it and
+asserting parser/schema agreement on rejection cases.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+SCHEMA_ID = "https://dora-tpu.dev/dora-schema.json"
+
+#: "<node>/<output>" (output may be namespaced further) or a dora timer.
+_INPUT_MAPPING_PATTERN = r"^[^/\s]+(/[^/\s]+)+$"
+
+_INPUT = {
+    "description": (
+        "Input slot: '<node>/<output>' / 'dora/timer/<unit>/<n>' string, "
+        "or a mapping with an explicit bounded queue size."
+    ),
+    "oneOf": [
+        {"type": "string", "pattern": _INPUT_MAPPING_PATTERN},
+        {
+            "type": "object",
+            "properties": {
+                "source": {
+                    "type": "string",
+                    "pattern": _INPUT_MAPPING_PATTERN,
+                },
+                "queue_size": {"type": "integer", "minimum": 1},
+            },
+            "required": ["source"],
+            "additionalProperties": False,
+        },
+    ],
+}
+
+_INPUTS = {
+    "type": "object",
+    "additionalProperties": {"$ref": "#/definitions/input"},
+}
+
+_OUTPUTS = {
+    "type": "array",
+    "items": {"type": "string", "minLength": 1},
+}
+
+_ENV = {
+    "type": "object",
+    "additionalProperties": {"type": ["string", "number", "boolean"]},
+}
+
+_DEPLOY = {
+    "type": "object",
+    "properties": {"machine": {"type": "string"}},
+    "additionalProperties": False,
+}
+
+_OPERATOR = {
+    "description": (
+        "One operator hosted by the runtime: exactly one source of "
+        "python / shared-library / jax."
+    ),
+    "type": "object",
+    "properties": {
+        "id": {"type": "string", "minLength": 1},
+        "name": {"type": "string"},
+        "description": {"type": "string"},
+        "build": {"type": "string"},
+        "send_stdout_as": {"type": "string"},
+        "inputs": {"$ref": "#/definitions/inputs"},
+        "outputs": {"$ref": "#/definitions/outputs"},
+        "python": {
+            "oneOf": [
+                {"type": "string"},
+                {
+                    "type": "object",
+                    "properties": {
+                        "source": {"type": "string"},
+                        "conda_env": {"type": "string"},
+                    },
+                    "required": ["source"],
+                    "additionalProperties": False,
+                },
+            ]
+        },
+        "shared-library": {"type": "string"},
+        "jax": {
+            "type": "string",
+            "description": (
+                "TPU-tier operator factory: 'module.path:factory' or "
+                "'file.py:factory' returning a JaxOperator"
+            ),
+        },
+    },
+    "oneOf": [
+        {"required": ["python"]},
+        {"required": ["shared-library"]},
+        {"required": ["jax"]},
+    ],
+    "additionalProperties": False,
+}
+
+_CUSTOM = {
+    "type": "object",
+    "properties": {
+        "source": {"type": "string"},
+        "args": {"type": "string"},
+        "build": {"type": "string"},
+        "send_stdout_as": {"type": "string"},
+        "envs": {"$ref": "#/definitions/env"},
+        "inputs": {"$ref": "#/definitions/inputs"},
+        "outputs": {"$ref": "#/definitions/outputs"},
+    },
+    "required": ["source"],
+    "additionalProperties": False,
+}
+
+_NODE = {
+    "type": "object",
+    "properties": {
+        "id": {"type": "string", "minLength": 1},
+        "name": {"type": "string"},
+        "description": {"type": "string"},
+        "env": {"$ref": "#/definitions/env"},
+        "deploy": {"$ref": "#/definitions/deploy"},
+        "_unstable_deploy": {"$ref": "#/definitions/deploy"},
+        # node kinds (exactly one)
+        "path": {
+            "type": "string",
+            "description": (
+                "Executable / script path, 'shell', 'dynamic', a "
+                "'module:pkg.mod' Python module, or a URL"
+            ),
+        },
+        "custom": {"$ref": "#/definitions/custom"},
+        "operators": {
+            "type": "array",
+            "items": {"$ref": "#/definitions/operator"},
+            "minItems": 1,
+        },
+        "operator": {"$ref": "#/definitions/operator"},
+        # custom-node keys allowed beside `path:`
+        "args": {"type": "string"},
+        "build": {"type": "string"},
+        "send_stdout_as": {"type": "string"},
+        "inputs": {"$ref": "#/definitions/inputs"},
+        "outputs": {"$ref": "#/definitions/outputs"},
+    },
+    "required": ["id"],
+    "oneOf": [
+        {"required": ["path"]},
+        {"required": ["custom"]},
+        {"required": ["operators"]},
+        {"required": ["operator"]},
+    ],
+    # Keep additionalProperties open like the reference's published schema
+    # (generate_schema.rs flips it to true so IDEs keep validating `id`
+    # even inside the oneOf variants).
+    "additionalProperties": True,
+}
+
+_COMMUNICATION = {
+    "type": "object",
+    "properties": {
+        "local": {
+            "oneOf": [
+                {"type": "string", "enum": ["tcp", "uds", "shmem"]},
+                {
+                    "type": "object",
+                    "properties": {"kind": {"type": "string"}},
+                    "additionalProperties": True,
+                },
+            ]
+        },
+        "_unstable_local": True,
+        "remote": {
+            "oneOf": [
+                {"type": "string", "enum": ["tcp"]},
+                {
+                    "type": "object",
+                    "properties": {"kind": {"type": "string"}},
+                    "additionalProperties": True,
+                },
+            ]
+        },
+        "_unstable_remote": True,
+    },
+    "additionalProperties": False,
+}
+
+
+def descriptor_schema() -> dict[str, Any]:
+    """The dataflow-YAML JSON Schema (draft-07)."""
+    return {
+        "$schema": "http://json-schema.org/draft-07/schema#",
+        "$id": SCHEMA_ID,
+        "title": "dora-tpu dataflow descriptor",
+        "type": "object",
+        "properties": {
+            "nodes": {
+                "type": "array",
+                "items": {"$ref": "#/definitions/node"},
+                "minItems": 1,
+            },
+            "communication": {"$ref": "#/definitions/communication"},
+            "deploy": {"$ref": "#/definitions/deploy"},
+            "_unstable_deploy": {"$ref": "#/definitions/deploy"},
+            "env": {"$ref": "#/definitions/env"},
+        },
+        "required": ["nodes"],
+        "additionalProperties": False,
+        "definitions": {
+            "node": _NODE,
+            "operator": _OPERATOR,
+            "custom": _CUSTOM,
+            "input": _INPUT,
+            "inputs": _INPUTS,
+            "outputs": _OUTPUTS,
+            "env": _ENV,
+            "deploy": _DEPLOY,
+            "communication": _COMMUNICATION,
+        },
+    }
+
+
+def generate_schema(path: str | Path | None = None) -> Path:
+    """Write ``dora-schema.json`` (reference: generate_schema.rs writes it
+    next to the core crate's Cargo.toml)."""
+    out = Path(path) if path else Path("dora-schema.json")
+    out.write_text(json.dumps(descriptor_schema(), indent=2) + "\n")
+    return out
+
+
+def main() -> int:
+    import sys
+
+    out = generate_schema(sys.argv[1] if len(sys.argv) > 1 else None)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

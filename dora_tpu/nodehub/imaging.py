@@ -1,0 +1,44 @@
+"""Shared image-frame decoding for node-hub sinks.
+
+The wire contract for camera-class producers (reference:
+opencv-video-capture, dora-rerun src/main.rs:60-120): a flat uint8 array
+plus metadata ``encoding`` (bgr8 | rgb8 | jpeg | png), ``width``,
+``height``. Sinks (visualizer, dataset recorder) decode to RGB [H, W, 3]
+uint8 through this module.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def as_numpy(value, metadata=None) -> np.ndarray:
+    import pyarrow as pa
+
+    from dora_tpu.tpu.bridge import arrow_to_host
+
+    if isinstance(value, pa.Array):
+        return np.asarray(arrow_to_host(value, metadata))
+    return np.asarray(memoryview(value), dtype=np.uint8)
+
+
+def decode_image(value, metadata) -> np.ndarray | None:
+    """Metadata-driven decode to RGB [H, W, 3] uint8; None when the
+    payload is too small for the declared geometry."""
+    encoding = str(metadata.get("encoding", "bgr8"))
+    if encoding in ("jpeg", "png"):
+        from PIL import Image
+
+        data = bytes(as_numpy(value).astype(np.uint8).reshape(-1))
+        return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    width = int(metadata.get("width", 640))
+    height = int(metadata.get("height", 480))
+    flat = as_numpy(value, metadata).astype(np.uint8).reshape(-1)
+    if flat.size < width * height * 3:
+        return None
+    frame = flat[: width * height * 3].reshape(height, width, 3)
+    if encoding == "bgr8":
+        frame = frame[..., ::-1]
+    return frame

@@ -24,7 +24,7 @@ from pathlib import Path
 import numpy as np
 
 from dora_tpu.node import Node
-from dora_tpu.nodehub.rerun_sink import _decode_image
+from dora_tpu.nodehub.imaging import decode_image
 
 DATASET_TAGS = {
     "role_tag": "role",
@@ -121,7 +121,7 @@ def main() -> None:
                 continue
             input_id = event["id"]
             if "image" in input_id:
-                frame = _decode_image(event["value"], event["metadata"])
+                frame = decode_image(event["value"], event["metadata"])
                 if frame is not None:
                     frames[input_id] = frame
             elif input_id == "text":

@@ -38,33 +38,8 @@ def _try_rerun():
         return None
 
 
-def _as_numpy(value, metadata=None) -> np.ndarray:
-    import pyarrow as pa
-
-    from dora_tpu.tpu.bridge import arrow_to_host
-
-    if isinstance(value, pa.Array):
-        return np.asarray(arrow_to_host(value, metadata))
-    return np.asarray(memoryview(value), dtype=np.uint8)
-
-
-def _decode_image(value, metadata) -> np.ndarray | None:
-    """Metadata-driven decode to RGB [H, W, 3] uint8 (reference encodings)."""
-    encoding = str(metadata.get("encoding", "bgr8"))
-    if encoding in ("jpeg", "png"):
-        from PIL import Image
-
-        data = bytes(_as_numpy(value).astype(np.uint8).reshape(-1))
-        return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
-    width = int(metadata.get("width", 640))
-    height = int(metadata.get("height", 480))
-    flat = _as_numpy(value, metadata).astype(np.uint8).reshape(-1)
-    if flat.size < width * height * 3:
-        return None
-    frame = flat[: width * height * 3].reshape(height, width, 3)
-    if encoding == "bgr8":
-        frame = frame[..., ::-1]
-    return frame
+from dora_tpu.nodehub.imaging import as_numpy as _as_numpy
+from dora_tpu.nodehub.imaging import decode_image as _decode_image
 
 
 def _decode_boxes(value, metadata) -> dict:
@@ -155,7 +130,12 @@ class HtmlReplay:
     def log_image(self, input_id: str, frame: np.ndarray) -> None:
         if len(self.frames) >= self.max_frames:
             return
-        self.size = (frame.shape[1], frame.shape[0])
+        # Canvas must fit the largest stream (several "*image*" inputs of
+        # different resolutions can share this sink).
+        self.size = (
+            max(self.size[0], frame.shape[1]) if self.frames else frame.shape[1],
+            max(self.size[1], frame.shape[0]) if self.frames else frame.shape[0],
+        )
         self.frames.append(
             {"id": input_id, "png": _png_b64(frame), "boxes": self.pending_boxes}
         )

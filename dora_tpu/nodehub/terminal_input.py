@@ -39,12 +39,14 @@ def _to_payload(value):
 
 def _connect(node_id: str | None) -> Node:
     daemon_addr = os.environ.get("DORA_DAEMON_ADDR")
+    if not node_id:
+        # Spawned mode: a failure here (e.g. no DORA_NODE_CONFIG) is
+        # permanent — surface it instead of retrying.
+        return Node()
     last_err = ""
     while True:
         try:
-            if node_id:
-                return Node(node_id=node_id, daemon_addr=daemon_addr)
-            return Node()
+            return Node(node_id=node_id, daemon_addr=daemon_addr)
         except (OSError, RuntimeError) as err:  # dataflow not up yet
             if str(err) != last_err:
                 print(err)

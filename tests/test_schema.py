@@ -1,0 +1,114 @@
+"""Descriptor JSON-schema generation (reference parity:
+libraries/core/src/bin/generate_schema.rs -> dora-schema.json).
+
+The schema must accept every shipped example dataflow and agree with the
+parser on malformed inputs.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+jsonschema = pytest.importorskip("jsonschema")
+
+from dora_tpu.core.descriptor import Descriptor
+from dora_tpu.core.schema import descriptor_schema, generate_schema
+
+REPO = Path(__file__).resolve().parents[1]
+EXAMPLES = sorted((REPO / "examples").glob("*/*.yml"))
+
+
+@pytest.fixture(scope="module")
+def validator():
+    schema = descriptor_schema()
+    jsonschema.Draft7Validator.check_schema(schema)
+    return jsonschema.Draft7Validator(schema)
+
+
+@pytest.mark.parametrize(
+    "path", EXAMPLES, ids=[str(p.relative_to(REPO)) for p in EXAMPLES]
+)
+def test_every_example_validates(validator, path):
+    doc = yaml.safe_load(path.read_text())
+    errors = list(validator.iter_errors(doc))
+    assert not errors, "\n".join(e.message for e in errors)
+
+
+REJECTED = [
+    # (yaml text, why)
+    ("nodes: []", "empty nodes list"),
+    ("nodes: [{path: x.py}]", "node missing id"),
+    ("nodes: [{id: a}]", "no node kind"),
+    ("nodes: [{id: a, path: x.py, operator: {jax: m:f}}]", "two node kinds"),
+    (
+        "nodes: [{id: a, operator: {id: op}}]",
+        "operator without a source",
+    ),
+    (
+        "nodes: [{id: a, operator: {jax: m:f, python: y.py}}]",
+        "operator with two sources",
+    ),
+    (
+        "nodes: [{id: a, path: x.py, inputs: {t: tick}}]",
+        "input mapping without a slash",
+    ),
+    (
+        "nodes: [{id: a, path: x.py, inputs: {t: {queue_size: 1}}}]",
+        "input mapping missing source",
+    ),
+    ("top: 1\nnodes: [{id: a, path: x.py}]", "unknown top-level key"),
+]
+
+
+@pytest.mark.parametrize("text,why", REJECTED, ids=[w for _, w in REJECTED])
+def test_schema_and_parser_agree_on_rejection(validator, text, why):
+    doc = yaml.safe_load(text)
+    assert list(validator.iter_errors(doc)), f"schema accepted: {why}"
+    with pytest.raises((ValueError, KeyError)):
+        descriptor = Descriptor.parse(doc)
+        for node in descriptor.nodes:  # force input parsing
+            node.inputs  # noqa: B018
+
+
+def test_generate_schema_writes_file(tmp_path):
+    out = generate_schema(tmp_path / "dora-schema.json")
+    loaded = json.loads(out.read_text())
+    assert loaded["title"] == "dora-tpu dataflow descriptor"
+    assert "node" in loaded["definitions"]
+
+
+def test_checked_in_schema_is_current():
+    """The published dora-schema.json must match the generator (regenerate
+    with `dora-tpu schema -o dora-schema.json` after grammar changes)."""
+    published = json.loads((REPO / "dora-schema.json").read_text())
+    assert published == descriptor_schema()
+
+
+def test_cli_schema_command(tmp_path):
+    result = subprocess.run(
+        [sys.executable, "-m", "dora_tpu.cli.main", "schema"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert result.returncode == 0, result.stderr
+    schema = json.loads(result.stdout)
+    assert schema["$schema"].endswith("draft-07/schema#")
+
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "dora_tpu.cli.main", "schema",
+            "-o", str(tmp_path / "s.json"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert result.returncode == 0, result.stderr
+    assert (tmp_path / "s.json").exists()
