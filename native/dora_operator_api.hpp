@@ -1,0 +1,160 @@
+// dora-tpu C++ operator API: RAII convenience over the C operator ABI.
+//
+// Reference parity: apis/c++/operator (src/lib.rs:60-98 wraps a
+// user-defined C++ class behind the DoraOperator trait). Here the same
+// shape is pure C++: subclass dora::Operator, override on_event (or the
+// on_input convenience), and register with one macro — the macro emits
+// the three C ABI symbols (dora_operator_api.h) with exception-safe
+// new/delete lifetime management.
+//
+//   #include "dora_operator_api.hpp"
+//
+//   class Counter : public dora::Operator {
+//     int count_ = 0;
+//     dora::Status on_input(std::string_view id, dora::Bytes data,
+//                           dora::OutputSender& out) override {
+//       ++count_;
+//       out.send("count", &count_, sizeof count_);
+//       return dora::Status::Continue;
+//     }
+//   };
+//
+//   DORA_REGISTER_OPERATOR(Counter)
+
+#pragma once
+
+#include <cstddef>
+#include <cstdio>
+#include <exception>
+#include <string>
+#include <string_view>
+#include <vector>
+
+#include "dora_operator_api.h"
+
+namespace dora {
+
+enum class Status : int {
+  Continue = DORA_OP_CONTINUE,
+  Stop = DORA_OP_STOP,
+  StopAll = DORA_OP_STOP_ALL,
+};
+
+struct Bytes {
+  const unsigned char* data = nullptr;
+  size_t len = 0;
+
+  std::string_view view() const {
+    return {reinterpret_cast<const char*>(data), len};
+  }
+  std::vector<unsigned char> copy() const { return {data, data + len}; }
+};
+
+// Publishes outputs for the current event; valid only inside on_event.
+class OutputSender {
+ public:
+  explicit OutputSender(const DoraOperatorSendOutput* raw) : raw_(raw) {}
+
+  bool send(std::string_view output_id, const void* data, size_t len,
+            const char* encoding = "raw") {
+    std::string id(output_id);  // ABI wants NUL-terminated
+    return raw_->send(raw_->context, id.c_str(),
+                      static_cast<const unsigned char*>(data), len,
+                      encoding) == 0;
+  }
+  bool send(std::string_view output_id, const std::string& text,
+            const char* encoding = "raw") {
+    return send(output_id, text.data(), text.size(), encoding);
+  }
+  bool send(std::string_view output_id, const std::vector<unsigned char>& data,
+            const char* encoding = "raw") {
+    return send(output_id, data.data(), data.size(), encoding);
+  }
+
+ private:
+  const DoraOperatorSendOutput* raw_;
+};
+
+struct Event {
+  DoraOperatorEventType type;
+  std::string_view id;        // input id (empty for STOP)
+  Bytes data;                 // payload (empty if none)
+  std::string_view encoding;  // "raw" | "arrow-ipc"
+};
+
+class Operator {
+ public:
+  virtual ~Operator() = default;
+
+  // Full event hook; the default dispatches INPUT to on_input and
+  // ignores the rest (the reference wrapper does the same,
+  // apis/c++/operator/src/lib.rs:92-97).
+  virtual Status on_event(const Event& event, OutputSender& out) {
+    if (event.type == DORA_OP_EVENT_INPUT)
+      return on_input(event.id, event.data, out);
+    return Status::Continue;
+  }
+
+  virtual Status on_input(std::string_view /*id*/, Bytes /*data*/,
+                          OutputSender& /*out*/) {
+    return Status::Continue;
+  }
+};
+
+namespace detail {
+
+template <typename Op>
+void* init_operator() noexcept {
+  try {
+    return new Op();
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "dora operator init failed: %s\n", e.what());
+    return nullptr;
+  } catch (...) {
+    std::fprintf(stderr, "dora operator init failed\n");
+    return nullptr;
+  }
+}
+
+inline void drop_operator(void* state) noexcept {
+  delete static_cast<Operator*>(state);
+}
+
+inline int on_event(void* state, const DoraOperatorEvent* raw,
+                    const DoraOperatorSendOutput* send_output) noexcept {
+  // An exception escaping on_event stops the whole dataflow — matching
+  // the reference, where a returned error string fails the operator
+  // (src/lib.rs:84-90); this ABI has no error channel, so report + stop.
+  try {
+    Event event{
+        raw->type,
+        raw->id ? std::string_view(raw->id) : std::string_view(),
+        Bytes{raw->data, raw->data_len},
+        raw->encoding ? std::string_view(raw->encoding) : std::string_view(),
+    };
+    OutputSender out(send_output);
+    return static_cast<int>(
+        static_cast<Operator*>(state)->on_event(event, out));
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "dora operator error: %s\n", e.what());
+    return DORA_OP_STOP_ALL;
+  } catch (...) {
+    std::fprintf(stderr, "dora operator error\n");
+    return DORA_OP_STOP_ALL;
+  }
+}
+
+}  // namespace detail
+}  // namespace dora
+
+#define DORA_REGISTER_OPERATOR(OperatorClass)                                \
+  extern "C" void* dora_init_operator(void) {                                \
+    return ::dora::detail::init_operator<OperatorClass>();                   \
+  }                                                                          \
+  extern "C" void dora_drop_operator(void* state) {                          \
+    ::dora::detail::drop_operator(state);                                    \
+  }                                                                          \
+  extern "C" int dora_on_event(void* state, const DoraOperatorEvent* event,  \
+                               const DoraOperatorSendOutput* send_output) {  \
+    return ::dora::detail::on_event(state, event, send_output);              \
+  }

@@ -1,0 +1,186 @@
+// Sanitizer harness for the native layer (SURVEY §5.2: run the C++ under
+// TSAN/ASAN in CI — the reference only documents `cargo careful`/miri for
+// its Rust core; this build does better by actually exercising the shmem
+// transport and the operator ABI under the sanitizers on every test run).
+//
+// Build (tests/test_sanitizers.py):
+//   g++ -std=c++17 -g -fsanitize=address,undefined sanitize_test.cpp shmem.cpp
+//   g++ -std=c++17 -g -fsanitize=thread            sanitize_test.cpp shmem.cpp
+//
+// Exercises, with a real concurrent server/client pair:
+//   1. raw regions: create/open/write/read/close/unlink
+//   2. request-reply channels: blocking send/recv, zero-copy recv_ptr,
+//      try_send backpressure, disconnect propagation
+//   3. the C++ operator RAII wrapper end to end (init/on_event/drop)
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "dora_operator_api.hpp"
+#include "dtp_shmem.h"
+
+namespace {
+
+std::string unique_name(const char* base) {
+  return std::string(base) + "-" + std::to_string(getpid());
+}
+
+void test_regions() {
+  const std::string name = unique_name("/dtp-san-region");
+  void* region = dtp_region_create(name.c_str(), 1 << 16);
+  assert(region != nullptr);
+  auto* ptr = static_cast<unsigned char*>(dtp_region_ptr(region));
+  assert(dtp_region_size(region) == (1 << 16));
+  std::memset(ptr, 0xAB, 1 << 16);
+
+  void* reader = dtp_region_open(name.c_str());
+  assert(reader != nullptr);
+  auto* rptr = static_cast<unsigned char*>(dtp_region_ptr(reader));
+  for (int i = 0; i < (1 << 16); i += 4096) assert(rptr[i] == 0xAB);
+  dtp_region_close(reader, 0);
+  dtp_region_close(region, 1);
+  std::puts("regions ok");
+}
+
+void test_channel_concurrent() {
+  const std::string name = unique_name("/dtp-san-chan");
+  void* server = dtp_channel_create(name.c_str(), 1 << 12);
+  assert(server != nullptr);
+  constexpr int kRounds = 500;
+
+  std::thread server_thread([&] {
+    std::vector<uint8_t> buf(1 << 12);
+    for (int i = 0; i < kRounds; i++) {
+      // Alternate copy-out recv and zero-copy recv_ptr paths.
+      if (i % 2 == 0) {
+        int64_t n = dtp_channel_recv(server, buf.data(), buf.size(),
+                                     /*timeout_ms=*/10000, /*is_server=*/1);
+        assert(n >= 0);
+        assert(std::memcmp(buf.data(), &i, sizeof i) == 0);
+      } else {
+        const uint8_t* view = nullptr;
+        int64_t n = dtp_channel_recv_ptr(server, &view, 10000, 1);
+        assert(n >= 0 && view != nullptr);
+        assert(std::memcmp(view, &i, sizeof i) == 0);
+        dtp_channel_recv_done(server, 1);
+      }
+      int rc = dtp_channel_send(server, reinterpret_cast<uint8_t*>(&i),
+                                sizeof i, /*is_server=*/1);
+      assert(rc == 0);
+    }
+  });
+
+  void* client = dtp_channel_open(name.c_str());
+  assert(client != nullptr);
+  assert(dtp_channel_capacity(client) == (1 << 12));
+  for (int i = 0; i < kRounds; i++) {
+    int rc = dtp_channel_send(client, reinterpret_cast<uint8_t*>(&i), sizeof i,
+                              /*is_server=*/0);
+    assert(rc == 0);
+    int reply = -1;
+    int64_t n = dtp_channel_recv(client, reinterpret_cast<uint8_t*>(&reply),
+                                 sizeof reply, 10000, /*is_server=*/0);
+    assert(n == sizeof reply);
+    assert(reply == i);
+  }
+  server_thread.join();
+
+  dtp_channel_disconnect(client);
+  assert(dtp_channel_is_disconnected(server) == 1);
+  dtp_channel_close(client, 0);
+  dtp_channel_close(server, 1);
+  std::puts("channel ok");
+}
+
+void test_channel_try_send_backpressure() {
+  const std::string name = unique_name("/dtp-san-bp");
+  void* server = dtp_channel_create(name.c_str(), 256);
+  void* client = dtp_channel_open(name.c_str());
+  uint8_t payload[64] = {7};
+  // First try_send lands, second must refuse while unconsumed.
+  assert(dtp_channel_try_send(client, payload, sizeof payload, 0) == 0);
+  assert(dtp_channel_try_send(client, payload, sizeof payload, 0) != 0);
+  uint8_t buf[256];
+  assert(dtp_channel_recv(server, buf, sizeof buf, 1000, 1) ==
+         sizeof payload);
+  assert(dtp_channel_try_send(client, payload, sizeof payload, 0) == 0);
+  dtp_channel_close(client, 0);
+  dtp_channel_close(server, 1);
+  std::puts("backpressure ok");
+}
+
+}  // namespace
+
+// --- C++ operator wrapper under the sanitizer ------------------------------
+
+class SanOperator : public dora::Operator {
+  std::string last_;
+  int count_ = 0;
+
+  dora::Status on_input(std::string_view id, dora::Bytes data,
+                        dora::OutputSender& out) override {
+    last_.assign(data.view());
+    ++count_;
+    out.send("echo", last_);
+    out.send("count", &count_, sizeof count_);
+    return count_ < 3 ? dora::Status::Continue : dora::Status::Stop;
+  }
+};
+
+DORA_REGISTER_OPERATOR(SanOperator)
+
+namespace {
+
+struct Captured {
+  std::vector<std::string> ids;
+  std::vector<std::vector<unsigned char>> payloads;
+};
+
+int capture_send(void* context, const char* output_id,
+                 const unsigned char* data, size_t len, const char*) {
+  auto* cap = static_cast<Captured*>(context);
+  cap->ids.emplace_back(output_id);
+  cap->payloads.emplace_back(data, data + len);
+  return 0;
+}
+
+void test_operator_wrapper() {
+  void* state = dora_init_operator();
+  assert(state != nullptr);
+  Captured cap;
+  DoraOperatorSendOutput sender{&cap, capture_send};
+  const char* msg = "hello";
+  DoraOperatorEvent event{DORA_OP_EVENT_INPUT, "in",
+                          reinterpret_cast<const unsigned char*>(msg),
+                          5, "raw"};
+  assert(dora_on_event(state, &event, &sender) == DORA_OP_CONTINUE);
+  assert(dora_on_event(state, &event, &sender) == DORA_OP_CONTINUE);
+  assert(dora_on_event(state, &event, &sender) == DORA_OP_STOP);
+  assert(cap.ids.size() == 6);
+  assert(cap.ids[0] == "echo" && cap.ids[1] == "count");
+  assert(std::string(cap.payloads[0].begin(), cap.payloads[0].end()) ==
+         "hello");
+  DoraOperatorEvent stop{DORA_OP_EVENT_STOP, nullptr, nullptr, 0, nullptr};
+  assert(dora_on_event(state, &stop, &sender) == DORA_OP_CONTINUE);
+  dora_drop_operator(state);
+  std::puts("operator wrapper ok");
+}
+
+}  // namespace
+
+int main() {
+  test_regions();
+  test_channel_concurrent();
+  test_channel_try_send_backpressure();
+  test_operator_wrapper();
+  std::puts("sanitize_test ok");
+  return 0;
+}

@@ -107,3 +107,87 @@ def test_shared_lib_operator_e2e(tmp_path):
     assert result.is_ok(), result.errors()
     log_dir = next((tmp_path / "out").iterdir())
     assert "shared-lib operator ok" in (log_dir / "log_checker.txt").read_text()
+
+
+CPP_WRAPPER_OPERATOR_SRC = """
+    #include <string>
+
+    #include "dora_operator_api.hpp"
+
+    // Written against the C++ RAII wrapper (reference parity:
+    // apis/c++/operator): subclass + one registration macro.
+    class Shouter : public dora::Operator {
+      int seen_ = 0;
+
+      dora::Status on_input(std::string_view id, dora::Bytes data,
+                            dora::OutputSender& out) override {
+        ++seen_;
+        std::string reply = std::string(id) + "#" +
+                            std::to_string(seen_) + ":" +
+                            std::to_string(data.len);
+        out.send("reply", reply);
+        return dora::Status::Continue;
+      }
+    };
+
+    DORA_REGISTER_OPERATOR(Shouter)
+"""
+
+
+def test_cpp_wrapper_operator_e2e(tmp_path):
+    """An operator written against dora_operator_api.hpp (RAII wrapper +
+    DORA_REGISTER_OPERATOR) runs in the runtime next to Python nodes."""
+    src = tmp_path / "shouter.cpp"
+    src.write_text(textwrap.dedent(CPP_WRAPPER_OPERATOR_SRC))
+    lib = tmp_path / "libshouter.so"
+    proc = subprocess.run(
+        ["g++", "-O1", "-shared", "-fPIC", "-std=c++17", "-I", str(NATIVE),
+         str(src), "-o", str(lib)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    checker = tmp_path / "check_replies.py"
+    checker.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        node = Node()
+        replies = []
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            replies.append(bytes(event["value"]).decode())
+        node.close()
+        assert len(replies) == 2, replies
+        assert replies[0].startswith("in#1:") and replies[1].startswith("in#2:")
+        print("cpp wrapper ok")
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": "[9, 9]", "COUNT": "2"},
+            },
+            {
+                "id": "shouter",
+                "operator": {
+                    "shared-library": "shouter",
+                    "inputs": {"in": "sender/data"},
+                    "outputs": ["reply"],
+                },
+            },
+            {
+                "id": "checker",
+                "path": "check_replies.py",
+                "inputs": {"in": "shouter/op/reply"},
+            },
+        ]
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(df, timeout_s=120)
+    assert result.is_ok(), result.errors()
+    log_dir = next((tmp_path / "out").iterdir())
+    assert "cpp wrapper ok" in (log_dir / "log_checker.txt").read_text()
