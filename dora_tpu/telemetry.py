@@ -134,20 +134,106 @@ def span(name: str, parent_ctx: str = ""):
 # ---------------------------------------------------------------------------
 
 
-def init_metrics(name: str, interval_s: float = 10.0):
-    """Per-process system metrics via OTLP when configured; otherwise a
-    no-op handle with a .sample() you can call manually."""
+class MetricsSampler:
+    """Per-process system metrics (reference: dora-metrics exports
+    process CPU/memory/disk through an OTLP meter,
+    telemetry/metrics/src/lib.rs:25-49).
 
-    class _Sampler:
-        def sample(self) -> dict:
-            import resource
+    ``sample()`` always works (resource/psutil, no SDK needed) — the
+    daemon can log it or answer control-API queries with it. When the
+    OpenTelemetry *SDK* is installed and ``OTEL_EXPORTER_OTLP_ENDPOINT``
+    is set, the same samples also export periodically as OTLP gauges.
+    """
 
-            usage = resource.getrusage(resource.RUSAGE_SELF)
-            return {
-                "max_rss_kb": usage.ru_maxrss,
-                "user_s": usage.ru_utime,
-                "system_s": usage.ru_stime,
-                "time": time.time(),
-            }
+    def __init__(self, name: str):
+        self.name = name
+        self.exporting = False
+        self._proc = None
+        self._cached: dict | None = None
+        try:
+            import psutil
 
-    return _Sampler()
+            self._proc = psutil.Process()
+        except Exception:
+            self._proc = None
+
+    def sample(self) -> dict:
+        import resource
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        out = {
+            "max_rss_kb": usage.ru_maxrss,
+            "user_s": usage.ru_utime,
+            "system_s": usage.ru_stime,
+            "time": time.time(),
+        }
+        if self._proc is not None:
+            with self._proc.oneshot():
+                out["rss_bytes"] = self._proc.memory_info().rss
+                # psutil needs real time between cpu_percent calls; the
+                # previous call's timestamp provides it on every sample
+                # after the first.
+                out["cpu_percent"] = self._proc.cpu_percent(interval=None)
+                out["threads"] = self._proc.num_threads()
+        self._cached = out
+        return out
+
+    def sample_cached(self, max_age_s: float = 1.0) -> dict:
+        """The last sample if it is fresh, else a new one — so several
+        per-gauge OTLP callbacks in one export cycle share one reading
+        (back-to-back cpu_percent calls would read garbage)."""
+        if self._cached and time.time() - self._cached["time"] < max_age_s:
+            return self._cached
+        return self.sample()
+
+
+def init_metrics(name: str, interval_s: float = 10.0) -> MetricsSampler:
+    """System-metrics handle; wires periodic OTLP export when the otel SDK
+    and an endpoint are both present, mirroring ``set_up_tracing``."""
+    sampler = MetricsSampler(name)
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    if not endpoint:
+        return sampler
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (
+            OTLPMetricExporter,
+        )
+        from opentelemetry.metrics import set_meter_provider
+        from opentelemetry.sdk.metrics import MeterProvider
+        from opentelemetry.sdk.metrics.export import (
+            PeriodicExportingMetricReader,
+        )
+        from opentelemetry.sdk.resources import Resource
+
+        reader = PeriodicExportingMetricReader(
+            OTLPMetricExporter(endpoint=endpoint),
+            export_interval_millis=interval_s * 1000,
+        )
+        provider = MeterProvider(
+            resource=Resource.create({"service.name": name}),
+            metric_readers=[reader],
+        )
+        set_meter_provider(provider)
+        meter = provider.get_meter(name)
+
+        def observe(key: str):
+            def callback(_options):
+                from opentelemetry.metrics import Observation
+
+                # Cached: the three gauges of one export cycle must share
+                # one reading (see MetricsSampler.sample_cached).
+                value = sampler.sample_cached().get(key, 0.0)
+                return [Observation(float(value))]
+
+            return callback
+
+        for key in ("rss_bytes", "cpu_percent", "max_rss_kb"):
+            meter.create_observable_gauge(
+                f"process.{key}", callbacks=[observe(key)]
+            )
+        sampler.exporting = True
+    except ImportError:
+        logger.warning(
+            "opentelemetry SDK not installed; system metrics are local-only"
+        )
+    return sampler

@@ -177,12 +177,23 @@ class _TcpSubscription(Subscription):
 
 
 def zenoh_layer(*args, **kwargs) -> CommunicationLayer:  # pragma: no cover
-    """Zenoh backend (reference: pub-sub/src/zenoh.rs) — requires the
-    optional ``zenoh`` package, which this environment does not ship."""
+    """Zenoh backend slot (reference: pub-sub/src/zenoh.rs).
+
+    Decision (documented here on purpose): the TCP broker above is this
+    framework's *supported* pub-sub backend — it is wired, tested, and
+    carries the OpenAI-server example. The reference ships a zenoh
+    implementation of the same trait but nothing in its data plane uses
+    it either (communication-layer/pub-sub is dead code upstream). We
+    keep the slot so a zenoh backend can drop in behind the same
+    CommunicationLayer trait if/when a deployment needs brokerless
+    discovery, and fail with a clear message instead of half-working."""
     try:
         import zenoh  # noqa: F401
     except ImportError as e:
         raise RuntimeError(
             "the zenoh pub/sub backend requires the 'zenoh' package"
         ) from e
-    raise NotImplementedError("zenoh backend: planned")
+    raise NotImplementedError(
+        "zenoh backend: not implemented — use the TCP broker "
+        "(pubsub.tcp_layer), the supported backend"
+    )

@@ -38,6 +38,49 @@ def test_span_disabled_forwards_parent(monkeypatch):
         assert ctx == "traceparent:00-x-y-01;"
 
 
+def test_metrics_sampler_local_path(monkeypatch):
+    """init_metrics without an OTLP endpoint: a live sampler, no export."""
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    sampler = telemetry.init_metrics("test-proc")
+    assert not sampler.exporting
+    first = sampler.sample()
+    assert first["max_rss_kb"] > 0
+    assert first["user_s"] >= 0
+    # psutil is present in this image, so the richer gauges ride along.
+    assert first.get("rss_bytes", 1) > 0
+
+
+def test_metrics_endpoint_without_sdk_degrades(monkeypatch):
+    """Endpoint set but no otel SDK installed: warn + local-only sampler
+    (never raise) — the reference's meter is equally optional."""
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://localhost:4317")
+    try:
+        import opentelemetry.sdk.metrics  # noqa: F401
+
+        has_sdk = True
+    except ImportError:
+        has_sdk = False
+    sampler = telemetry.init_metrics("test-proc-otlp", interval_s=60)
+    try:
+        assert sampler.exporting == has_sdk
+        assert sampler.sample()["max_rss_kb"] > 0
+    finally:
+        if has_sdk:
+            # Stop the periodic export thread (endpoint is unreachable;
+            # a leaked provider would spam errors into later tests).
+            from opentelemetry.metrics import get_meter_provider
+
+            get_meter_provider().shutdown(timeout_millis=1000)
+
+
+def test_metrics_sample_cached_shares_reading():
+    sampler = telemetry.init_metrics("test-cache")
+    first = sampler.sample_cached()
+    assert sampler.sample_cached() is first  # fresh -> same reading
+    fresh = sampler.sample()
+    assert fresh is not first
+
+
 def test_download_file_url(tmp_path):
     src = tmp_path / "node.py"
     src.write_text("print('hi')")

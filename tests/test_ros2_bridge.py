@@ -1,0 +1,65 @@
+"""ROS2 DDS transport integration (reference parity:
+libraries/extensions/ros2-bridge e2e, apis/python ros2 tests).
+
+Runs only where a ROS2 installation provides rclpy (source the ROS2
+setup first); everywhere else the suite records an explicit skip, so the
+gated path is exercised on ROS2 machines instead of silently untested.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+rclpy = pytest.importorskip("rclpy")
+
+from dora_tpu.ros2.bridge import Ros2Context
+
+
+@pytest.fixture()
+def ros2_context():
+    ctx = Ros2Context()
+    yield ctx
+    ctx.close()
+
+
+def test_pub_sub_roundtrip_arrow(ros2_context):
+    """Publish std_msgs/String through DDS, receive it back as an Arrow
+    struct array via the mergeable subscription queue."""
+    import time
+
+    node = ros2_context.node("dora_tpu_test")
+    sub = node.subscription("/dora_tpu_echo", "std_msgs/String")
+    pub = node.publisher("/dora_tpu_echo", "std_msgs/String")
+
+    # DDS discovery needs a beat before the first publish lands.
+    deadline = time.time() + 10
+    received = None
+    while received is None and time.time() < deadline:
+        pub.publish({"data": "hello ros2"})
+        received = sub.recv(timeout=0.5)
+    assert received is not None, "no DDS roundtrip within 10 s"
+    decoded = received.to_pylist()[0]
+    assert decoded["data"] == "hello ros2"
+
+
+def test_publisher_accepts_arrow_struct(ros2_context):
+    import pyarrow as pa
+
+    from dora_tpu.ros2 import find_interface
+    from dora_tpu.ros2.arrow_convert import to_arrow
+
+    node = ros2_context.node("dora_tpu_test_arrow")
+    sub = node.subscription("/dora_tpu_arrow", "std_msgs/String")
+    pub = node.publisher("/dora_tpu_arrow", "std_msgs/String")
+
+    spec = find_interface("std_msgs/String")
+    arr = to_arrow([{"data": "from-arrow"}], spec, resolve=find_interface)
+    import time
+
+    deadline = time.time() + 10
+    received = None
+    while received is None and time.time() < deadline:
+        pub.publish(arr)
+        received = sub.recv(timeout=0.5)
+    assert received is not None
+    assert received.to_pylist()[0]["data"] == "from-arrow"
