@@ -61,6 +61,17 @@ class SharedLibrarySource:
 
 
 @dataclass(frozen=True)
+class WasmSource:
+    """A WASM operator module. Accepted by the descriptor for parity with
+    the reference, which declares this variant but does not run it
+    ("WASM operators are not supported yet",
+    binaries/runtime/src/operator/mod.rs:65-67; hidden from its schema via
+    schemars(skip)) — the runtime here rejects it with the same message."""
+
+    source: str
+
+
+@dataclass(frozen=True)
 class JaxSource:
     """A TPU-tier operator: ``module.path:factory`` or ``file.py:factory``.
 
@@ -76,7 +87,7 @@ class JaxSource:
         return (mod, fn if sep else "make_operator")
 
 
-OperatorSource = PythonSource | SharedLibrarySource | JaxSource
+OperatorSource = PythonSource | SharedLibrarySource | JaxSource | WasmSource
 
 
 @dataclass(frozen=True)
@@ -95,11 +106,13 @@ class OperatorDefinition:
         op_id = value.get("id", default_id)
         if op_id is None:
             raise ValueError(f"operator missing 'id': {value!r}")
-        sources = [k for k in ("python", "shared-library", "jax") if k in value]
+        sources = [
+            k for k in ("python", "shared-library", "jax", "wasm") if k in value
+        ]
         if len(sources) != 1:
             raise ValueError(
                 f"operator {op_id!r} must have exactly one of "
-                f"python/shared-library/jax, got {sources}"
+                f"python/shared-library/jax/wasm, got {sources}"
             )
         kind = sources[0]
         raw = value[kind]
@@ -112,6 +125,8 @@ class OperatorDefinition:
                 source = PythonSource(source=str(raw))
         elif kind == "shared-library":
             source = SharedLibrarySource(source=str(raw))
+        elif kind == "wasm":
+            source = WasmSource(source=str(raw))
         else:
             source = JaxSource(source=str(raw))
         return cls(

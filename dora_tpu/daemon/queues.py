@@ -68,15 +68,6 @@ class NodeEventQueue:
         self.closed = True
         self._wake()
 
-    def drain_now(self) -> list[Timestamped]:
-        out = []
-        while self.entries:
-            entry = self.entries.popleft()
-            if entry.input_id is not None:
-                self.input_counts[entry.input_id] -= 1
-            out.append(entry.event)
-        return out
-
     def release_all_tokens(self) -> None:
         """Stream abandoned (node died): ack every queued shmem token."""
         for entry in self.entries:
@@ -85,9 +76,16 @@ class NodeEventQueue:
         self.entries.clear()
         self.input_counts.clear()
 
+    #: Events handed out per NextEvent poll. Small on purpose: an event
+    #: delivered to the node has LEFT the drop-oldest domain — draining a
+    #: whole burst in one batch would let a fast producer bypass
+    #: queue_size for a slow consumer (the node's own buffer is equally
+    #: small, see node/events.py EventStream.DEFAULT_MAX_QUEUE).
+    MAX_BATCH = 4
+
     async def next_batch(self) -> list[Timestamped]:
-        """Block until events are available (or the stream closes); drain the
-        whole backlog in one batch. Empty list = stream closed."""
+        """Block until events are available (or the stream closes); hand
+        out up to MAX_BATCH. Empty list = stream closed."""
         while not self.entries:
             if self.closed:
                 return []
@@ -97,7 +95,13 @@ class NodeEventQueue:
                 await self.waiter
             except asyncio.CancelledError:
                 raise
-        return self.drain_now()
+        out = []
+        while self.entries and len(out) < self.MAX_BATCH:
+            entry = self.entries.popleft()
+            if entry.input_id is not None:
+                self.input_counts[entry.input_id] -= 1
+            out.append(entry.event)
+        return out
 
     def _wake(self) -> None:
         if self.waiter is not None and not self.waiter.done():

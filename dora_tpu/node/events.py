@@ -77,9 +77,19 @@ class EventStream:
     blocking NextEvent when the user drops the last event).
     """
 
-    def __init__(self, channel, on_ack=None, max_queue: int = 0):
+    #: Local buffer bound. Small on purpose: while the consumer lags, the
+    #: pump must STOP pulling so events back up in the *daemon's*
+    #: per-input queues, where the YAML ``queue_size`` drop-oldest
+    #: contract applies (reference: node_communication/mod.rs:320-359).
+    #: An unbounded local buffer would absorb every event the instant it
+    #: arrives and silently disable queue_size for fast producers.
+    DEFAULT_MAX_QUEUE = 2
+
+    def __init__(self, channel, on_ack=None, max_queue: int | None = None):
         self._channel = channel
         self._on_ack = on_ack
+        if max_queue is None:
+            max_queue = self.DEFAULT_MAX_QUEUE
         self._queue: queue_mod.Queue = queue_mod.Queue(max_queue)
         self._pending_acks: list[str] = []
         self._acks_lock = threading.Lock()
@@ -143,6 +153,17 @@ class EventStream:
 
     # -- pump thread --------------------------------------------------------
 
+    def _put(self, item) -> bool:
+        """Blocking put that gives up when the stream closes (a full
+        buffer must never wedge shutdown)."""
+        while not self._closed.is_set():
+            try:
+                self._queue.put(item, timeout=0.2)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
     def _run(self) -> None:
         try:
             while not self._closed.is_set():
@@ -153,13 +174,20 @@ class EventStream:
                     break
                 for ts in reply.events:
                     event = self._convert(ts.inner)
-                    if event is not None:
-                        self._queue.put(event)
+                    if event is not None and not self._put(event):
+                        return
         except Exception as e:
             if not self._closed.is_set():
-                self._queue.put(Event(type="ERROR", error=str(e)))
+                self._put(Event(type="ERROR", error=str(e)))
         finally:
-            self._queue.put(None)
+            # The end-of-stream sentinel must land (recv blocks without
+            # it); retry around a full buffer unless the consumer closed.
+            while not self._closed.is_set():
+                try:
+                    self._queue.put(None, timeout=0.2)
+                    break
+                except queue_mod.Full:
+                    continue
 
     def _convert(self, inner: Any) -> Event | None:
         if isinstance(inner, d2n.Input):

@@ -27,6 +27,7 @@ from dora_tpu.core.descriptor import (
     PythonSource,
     RuntimeNode,
     SharedLibrarySource,
+    WasmSource,
 )
 from dora_tpu.node import Node
 from dora_tpu.tpu.api import DoraStatus
@@ -105,16 +106,58 @@ class PythonOperatorHost:
 
 
 def run() -> int:
-    """Runtime node main loop (spawned with DORA_NODE_CONFIG set)."""
-    node = Node()
-    descriptor = Descriptor.parse(node.dataflow_descriptor())
-    me = descriptor.node(node.node_id)
+    """Runtime node main loop (spawned with DORA_NODE_CONFIG set).
+
+    Operator loading happens BEFORE ``Node()`` joins the start barrier:
+    a jax operator factory may initialize gigabytes of model weights on
+    the TPU, and subscribing first would release upstream producers (a
+    camera on a timer) minutes before this node can consume — the
+    barrier exists exactly to prevent that."""
+    import os as _os
+
+    from dora_tpu.daemon.spawn import NODE_CONFIG_ENV, decode_node_config
+
+    raw_config = _os.environ.get(NODE_CONFIG_ENV)
+    if not raw_config:
+        raise RuntimeError("runtime must be spawned by a daemon "
+                           f"({NODE_CONFIG_ENV} is not set)")
+    config = decode_node_config(raw_config)
+    descriptor = Descriptor.parse(config.dataflow_descriptor)
+    me = descriptor.node(config.node_id)
     if not isinstance(me.kind, RuntimeNode):
-        raise RuntimeError(f"node {node.node_id!r} is not a runtime node")
+        raise RuntimeError(f"node {config.node_id!r} is not a runtime node")
     working_dir = Path.cwd()
 
+    has_jax = any(
+        isinstance(op.source, JaxSource) for op in me.kind.operators
+    )
+    for op in me.kind.operators:
+        if isinstance(op.source, WasmSource):
+            # Reference parity: declared, not runnable
+            # (binaries/runtime/src/operator/mod.rs:65-67).
+            raise RuntimeError(
+                f"operator {op.id!r}: WASM operators are not supported yet"
+            )
+
+    fused = None
+    if has_jax:
+        import time as _time
+
+        from dora_tpu.tpu.fuse import FusedExecutor, FusedGraph
+
+        t0 = _time.perf_counter()
+        graph = FusedGraph.build(me, descriptor, working_dir)
+        fused = FusedExecutor(graph)
+        logger.info(
+            "fused %d jax operators in %.1fs (topo %s); external in=%s out=%s",
+            len(graph.operators), _time.perf_counter() - t0, graph.topo,
+            sorted(graph.external_inputs | graph.timer_inputs),
+            sorted(graph.external_outputs),
+        )
+
+    node = Node()  # subscribes: joins the start barrier only now
+    logger.info("subscribed; start barrier passed")
     python_hosts: dict[str, Any] = {}  # callback-style hosts (python + C ABI)
-    has_jax = False
     for op in me.kind.operators:
         if isinstance(op.source, PythonSource):
             python_hosts[str(op.id)] = PythonOperatorHost(op, node, working_dir)
@@ -124,22 +167,13 @@ def run() -> int:
             python_hosts[str(op.id)] = SharedLibOperatorHost(
                 op, node, working_dir
             )
-        elif isinstance(op.source, JaxSource):
-            has_jax = True
 
-    fused = None
-    if has_jax:
-        from dora_tpu.tpu.fuse import FusedExecutor, FusedGraph
-
-        graph = FusedGraph.build(me, descriptor, working_dir)
-        fused = FusedExecutor(graph)
-        logger.info(
-            "fused %d jax operators (topo %s); external in=%s out=%s",
-            len(graph.operators), graph.topo,
-            sorted(graph.external_inputs | graph.timer_inputs),
-            sorted(graph.external_outputs),
-        )
-
+    # Per-event processing honors the YAML queue_size contract end to
+    # end: while a tick runs, the node's bounded event buffer
+    # (EventStream.DEFAULT_MAX_QUEUE) stops pulling, events back up in
+    # the daemon's per-input queues, and drop-oldest applies there — a
+    # camera with queue_size 1 lags the fused model by at most the few
+    # in-flight events, never by an unbounded replayed backlog.
     stop_all = False
     for event in node:
         if event["type"] == "INPUT":

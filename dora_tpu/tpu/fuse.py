@@ -202,31 +202,51 @@ class FusedExecutor:
         }
         #: latest device value per external data input (latest-wins sampling)
         self.latest: dict[str, Any] = {}
+        self._compiled_once = False
         # Donate state so it is updated in place in HBM; on CPU donation is
         # unimplemented and only produces warnings, so skip it there.
         donate = (0,) if jax.default_backend() in ("tpu", "gpu") else ()
         self._jit = jax.jit(graph.step_fn, donate_argnums=donate)
         self._required = graph.external_inputs - graph.timer_inputs
 
-    def on_event(self, event_id: str, value, metadata: dict | None):
-        """Feed one arriving event; returns {output_id: (arrow, metadata)}
-        when the event triggered a tick, else None."""
+    def observe(self, event_id: str, value, metadata: dict | None) -> None:
+        """Record an input's latest value without ticking. Non-trigger
+        inputs only update the sample the next tick will read (latest
+        wins); backlog bounding itself is the queue layer's job
+        (daemon drop-oldest + the node's bounded event buffer)."""
         from dora_tpu.tpu.bridge import arrow_to_device
 
         if event_id in self._required and value is not None:
             self.latest[event_id] = arrow_to_device(value, metadata)
-        elif event_id not in self.graph.trigger_inputs:
-            return None
-        if event_id not in self.graph.trigger_inputs:
-            return None
+
+    def tick_if_ready(self):
+        """Run one tick when every required input has produced."""
         if not all(k in self.latest for k in self._required):
             return None  # warm-up: not every input has produced yet
         return self.tick()
 
+    def on_event(self, event_id: str, value, metadata: dict | None):
+        """Feed one arriving event; returns {output_id: (arrow, metadata)}
+        when the event triggered a tick, else None."""
+        self.observe(event_id, value, metadata)
+        if event_id not in self.graph.trigger_inputs:
+            return None
+        return self.tick_if_ready()
+
     def tick(self):
+        import logging
+        import time
+
         from dora_tpu.tpu.bridge import device_to_arrow
 
+        t0 = time.perf_counter()
         self.states, outputs = self._jit(self.states, dict(self.latest))
+        if not self._compiled_once:
+            self._compiled_once = True
+            logging.getLogger(__name__).info(
+                "fused step first tick (incl jit compile): %.1fs",
+                time.perf_counter() - t0,
+            )
         return {
             out_id: device_to_arrow(value) for out_id, value in outputs.items()
         }

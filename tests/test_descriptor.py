@@ -112,6 +112,24 @@ class TestParse:
         op = d.node("n").kind.operators[0]
         assert isinstance(op.source, SharedLibrarySource)
 
+    def test_wasm_operator_parses_but_does_not_run(self):
+        """Reference parity: the wasm source variant is declared in the
+        grammar but the runtime refuses it (operator/mod.rs:65-67)."""
+        from dora_tpu.core.descriptor import WasmSource
+
+        d = parse(
+            """
+            nodes:
+              - id: n
+                operators:
+                  - id: o
+                    wasm: ./op.wasm
+            """
+        )
+        op = d.node("n").kind.operators[0]
+        assert isinstance(op.source, WasmSource)
+        assert op.source.source == "./op.wasm"
+
     def test_dynamic_node(self):
         d = parse(
             """
