@@ -111,6 +111,14 @@ def attention(q, k, v, mask=None):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def use_flash() -> bool:
+    """Pallas block-streamed attention for the no-cache self-attention
+    paths (DORA_FLASH_ATTENTION=1; see dora_tpu.ops.flash_attention)."""
+    import os
+
+    return os.environ.get("DORA_FLASH_ATTENTION", "") not in ("", "0")
+
+
 def causal_mask(tq: int, tk: int, offset: int = 0):
     """[1,1,tq,tk] boolean mask; offset = number of cached tokens before q."""
     qi = jnp.arange(tq)[:, None] + offset
@@ -158,6 +166,7 @@ def block_forward(
     mlp: str = "swiglu",
     norm_eps: float = 1e-6,
     head_dim: int | None = None,
+    flash: str | None = None,
 ):
     """One pre-norm block. Returns (y, new_cache).
 
@@ -175,7 +184,7 @@ def block_forward(
         params, x, n_heads, n_kv_heads=n_kv_heads, rope=rope,
         positions=positions, rope_tables=rope_tables, mask=mask, cache=cache,
         cache_index=cache_index, mesh=mesh, ring_axis=ring_axis, norm=norm,
-        norm_eps=norm_eps, head_dim=head_dim,
+        norm_eps=norm_eps, head_dim=head_dim, flash=flash,
     )
     x = mlp_sublayer(params, x, norm=norm, mlp=mlp, norm_eps=norm_eps)
     return x, new_cache
@@ -184,13 +193,17 @@ def block_forward(
 def attention_sublayer(
     params, x, n_heads, *, n_kv_heads=None, rope=None, positions=None,
     rope_tables=None, mask=None, cache=None, cache_index=None, mesh=None,
-    ring_axis=None, norm="rms", norm_eps=1e-6, head_dim=None,
+    ring_axis=None, norm="rms", norm_eps=1e-6, head_dim=None, flash=None,
 ):
     """Pre-norm self-attention with residual. Returns (y, new_cache).
 
     Rotary comes either as ``rope=(cos, sin)`` position-indexed tables (+
     ``positions``), or as ``rope_tables=(cos, sin)`` per-token tables
     ([B, T, D/2] — the M-RoPE / 2-D vision case).
+
+    ``flash`` ("causal" | "full") routes the no-cache path through the
+    Pallas block-streamed kernel instead of dense+``mask`` — only valid
+    when the mask the caller would pass is exactly that pattern.
     """
     b, t, dim = x.shape
     n_kv = n_kv_heads or n_heads
@@ -233,6 +246,12 @@ def attention_sublayer(
         from dora_tpu.parallel.ring import ring_attention
 
         out = ring_attention(q, k, v, mesh, causal=mask is not None, axis=ring_axis)
+    elif flash is not None and cache is None:
+        from dora_tpu.ops import flash_attention
+
+        out = flash_attention(
+            q, k.astype(dtype), v.astype(dtype), causal=flash == "causal"
+        )
     else:
         out = attention(q, k.astype(dtype), v.astype(dtype), mask)
 

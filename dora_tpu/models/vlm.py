@@ -134,9 +134,10 @@ def encode_image(params, cfg: VLMConfig, images):
     x = patchify(images.astype(dtype), cfg.patch_size)
     x = x @ vp["patch_proj"].astype(dtype)
     x = x + vp["pos_embed"].astype(dtype)[None]
+    flash = "full" if L.use_flash() else None
     for i in range(cfg.vision_layers):
         x, _ = L.block_forward(
-            vp["blocks"][str(i)], x, cfg.vision_heads, mask=None
+            vp["blocks"][str(i)], x, cfg.vision_heads, mask=None, flash=flash
         )
     x = L.rms_norm(x, vp["out_norm"])
     return x @ vp["project"].astype(dtype)
@@ -149,7 +150,7 @@ def encode_image(params, cfg: VLMConfig, images):
 
 def _lm_forward(
     params, cfg: VLMConfig, h, positions, mask, caches=None, cache_index=None,
-    mesh=None, ring_axis=None,
+    mesh=None, ring_axis=None, flash=None,
 ):
     rope = L.rope_table(cfg.max_seq, cfg.head_dim)
     new_caches = {}
@@ -166,6 +167,7 @@ def _lm_forward(
             cache_index=cache_index,
             mesh=mesh,
             ring_axis=ring_axis,
+            flash=flash,
         )
         if new_cache is not None:
             new_caches[str(i)] = new_cache
@@ -263,11 +265,10 @@ def loss_fn(params, cfg: VLMConfig, batch, mesh=None, ring_axis=None):
     h = jnp.concatenate([img, txt], axis=1)
     seq = h.shape[1]
     positions = jnp.broadcast_to(jnp.arange(seq), (b, seq))
-    mask = None if ring_axis else L.causal_mask(seq, seq)
+    flash = "causal" if L.use_flash() and not ring_axis else None
     h, _ = _lm_forward(
-        params, cfg, h, positions,
-        mask if not ring_axis else L.causal_mask(seq, seq),
-        mesh=mesh, ring_axis=ring_axis,
+        params, cfg, h, positions, L.causal_mask(seq, seq),
+        mesh=mesh, ring_axis=ring_axis, flash=flash,
     )
     # Score only text positions: logits at [P-1 .. P+T-2] predict tokens.
     p = cfg.n_patches

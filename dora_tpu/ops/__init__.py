@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops.
+
+The XLA-compiled model code is already MXU-shaped (bfloat16 matmuls,
+static shapes); this package holds the places where a hand-written
+kernel beats what XLA fuses on its own — currently block-streamed
+attention (`flash_attention`), which keeps the [T, T] score matrix out
+of HBM for the training / vision-tower paths.
+"""
+
+from dora_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,112 @@
+"""Block-streamed attention as a Pallas TPU kernel.
+
+Dense attention (`dora_tpu.models.layers.attention`) materializes the
+[B, H, T, T] float32 score tensor in HBM — at T=2048 that is 16 MB per
+(batch, head) of write+read traffic XLA cannot always fuse away. This
+kernel streams query blocks through VMEM instead: for each q-block the
+scores exist only as a [BQ, T] VMEM tile, softmax runs in float32
+on-chip, and only the [BQ, D] output ever returns to HBM.
+
+Scope: the no-KV-cache paths — training loss, VLM prefill-style full
+sequences, and the ViT tower (non-causal). Decode attends against a
+cache one token at a time and has no score-matrix problem.
+
+Unaligned shapes are handled by padding T up to the 128-row block and D
+up to the 128-lane tile (zero-padded D contributes nothing to scores or
+outputs; padded key rows are masked to -inf before softmax), so the
+bench_2b ViT (head_dim 80, 256 patches + cls rows) works unchanged.
+
+On non-TPU backends the kernel runs through the Pallas interpreter —
+tests assert numeric parity with the dense reference on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_Q = 128
+LANE = 128
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, t_real: int,
+                      causal: bool, scale: float):
+    """One (batch*head, q-block) program: scores [BQ, T] live in VMEM.
+
+    Block shapes: q [1, BQ, D], k/v [1, T, D], o [1, BQ, D].
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+    k = k_ref[0].astype(jnp.float32)  # [T, D]
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [BQ, T]
+
+    t_pad = k.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    valid = col < t_real
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+        valid = valid & (col <= row + qi * BLOCK_Q)
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+
+    scores = scores - jnp.max(scores, axis=-1, keepdims=True)
+    probs = jnp.exp(scores)
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+    v = v_ref[0].astype(jnp.float32)  # [T, D]
+    out = jax.lax.dot_general(
+        probs, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def flash_attention(q, k, v, causal: bool = False):
+    """Attention over [B, H, T, D] without a [T, T] HBM score tensor.
+
+    Drop-in for ``layers.attention(q, k, v, causal_mask(T, T))`` /
+    ``layers.attention(q, k, v, None)`` (self-attention, same q/k
+    length). Softmax in float32; returns q.dtype.
+    """
+    b, h, t, d = q.shape
+    assert k.shape == v.shape == (b, h, t, d), (q.shape, k.shape)
+    scale = 1.0 / math.sqrt(d)
+
+    t_pad = _round_up(t, BLOCK_Q)
+    d_pad = _round_up(d, LANE)
+    if (t_pad, d_pad) != (t, d):
+        pad = ((0, 0), (0, 0), (0, t_pad - t), (0, d_pad - d))
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+
+    bh = b * h
+    q, k, v = (x.reshape(bh, t_pad, d_pad) for x in (q, k, v))
+
+    kernel = functools.partial(
+        _attention_kernel, t_real=t, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, t_pad // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d_pad), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+        interpret=jax.default_backend() not in ("tpu",),
+    )(q, k, v)
+
+    out = out.reshape(b, h, t_pad, d_pad)
+    return out[:, :, :t, :d]
