@@ -35,11 +35,27 @@ def _normalize(image):
 
 def _maybe_restore(params, name: str):
     path = os.environ.get("DORA_CHECKPOINT")
-    if not path:
-        return params
-    from dora_tpu.models.checkpoint import restore
+    if path:
+        from dora_tpu.models.checkpoint import restore
 
-    return restore(os.path.join(path, name), params)
+        params = restore(os.path.join(path, name), params)
+    return _maybe_cast(params)
+
+
+def _maybe_cast(params):
+    """DORA_PARAM_DTYPE=bfloat16: store weights HBM-resident in bf16
+    (serving config — halves memory, MXU-native; fp32 inits are freed
+    by donation)."""
+    dtype = os.environ.get("DORA_PARAM_DTYPE")
+    if not dtype:
+        return params
+    import jax.numpy as jnp
+
+    cast = jax.jit(
+        lambda p: jax.tree.map(lambda x: x.astype(jnp.dtype(dtype)), p),
+        donate_argnums=0,
+    )
+    return cast(params)
 
 
 def make_detector() -> JaxOperator:
