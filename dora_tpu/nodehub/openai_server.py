@@ -6,6 +6,15 @@ publishes the user text on the ``text`` output and returns the next value
 arriving on the ``response`` input. Stdlib http.server — no web-framework
 dependency.
 
+``"stream": true`` answers as Server-Sent Events
+(``chat.completion.chunk`` deltas + ``[DONE]``, proxy parity:
+openai-proxy-server/src/main.rs:368-399). A dataflow that emits its
+answer in several ``response`` messages streams each as one delta; the
+stream closes after ``STREAM_QUIET_MS`` (default 300) of silence
+following the first chunk. HTTP requests are merged into the node's
+event loop through a thread-safe queue — the stdlib counterpart of the
+reference proxy's merged external-events stream (main.rs:37,72).
+
 Dataflow usage::
 
     - id: api
@@ -33,6 +42,7 @@ def main() -> None:
     port = int(os.environ.get("PORT", "8123"))
     timeout_s = float(os.environ.get("RESPONSE_TIMEOUT", "30"))
     max_requests = int(os.environ.get("MAX_REQUESTS", "0"))  # 0 = serve forever
+    quiet_s = float(os.environ.get("STREAM_QUIET_MS", "300")) / 1000.0
     node = Node()
     responses: queue.Queue = queue.Queue()
     send_lock = threading.Lock()
@@ -67,6 +77,8 @@ def main() -> None:
             except (ValueError, AttributeError) as e:
                 self.send_error(400, str(e))
                 return
+            stream = bool(body.get("stream"))
+            model = body.get("model", "dora-tpu")
             with send_lock:
                 # Drain stale responses, publish, await the next one.
                 while not responses.empty():
@@ -77,22 +89,66 @@ def main() -> None:
                 except queue.Empty:
                     self.send_error(504, "dataflow did not answer in time")
                     return
-                served[0] += 1
-            self._json(
-                {
-                    "id": "chatcmpl-dora-tpu",
-                    "object": "chat.completion",
-                    "created": int(time.time()),
-                    "model": body.get("model", "dora-tpu"),
-                    "choices": [
-                        {
-                            "index": 0,
-                            "message": {"role": "assistant", "content": answer},
-                            "finish_reason": "stop",
-                        }
-                    ],
-                }
-            )
+                # From here the request counts as served no matter how the
+                # write ends (a client disconnect mid-stream must not keep
+                # a MAX_REQUESTS-bounded server alive forever) — but count
+                # only after the write so shutdown cannot race an
+                # in-flight response (the main loop polls `served`).
+                try:
+                    if stream:
+                        # Forward follow-up chunks until the dataflow goes
+                        # quiet (multi-message answers stream as deltas).
+                        self._sse_start()
+                        self._sse_chunk(model, {"role": "assistant"})
+                        self._sse_chunk(model, {"content": answer})
+                        while True:
+                            try:
+                                more = responses.get(timeout=quiet_s)
+                            except queue.Empty:
+                                break
+                            self._sse_chunk(model, {"content": more})
+                        self._sse_chunk(model, {}, finish="stop")
+                        self.wfile.write(b"data: [DONE]\n\n")
+                    else:
+                        self._json(
+                            {
+                                "id": "chatcmpl-dora-tpu",
+                                "object": "chat.completion",
+                                "created": int(time.time()),
+                                "model": model,
+                                "choices": [
+                                    {
+                                        "index": 0,
+                                        "message": {
+                                            "role": "assistant",
+                                            "content": answer,
+                                        },
+                                        "finish_reason": "stop",
+                                    }
+                                ],
+                            }
+                        )
+                finally:
+                    served[0] += 1
+
+        def _sse_start(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+        def _sse_chunk(self, model: str, delta: dict, finish=None):
+            payload = {
+                "id": "chatcmpl-dora-tpu",
+                "object": "chat.completion.chunk",
+                "created": int(time.time()),
+                "model": model,
+                "choices": [
+                    {"index": 0, "delta": delta, "finish_reason": finish}
+                ],
+            }
+            self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+            self.wfile.flush()
 
         def _json(self, payload: dict):
             data = json.dumps(payload).encode()

@@ -110,3 +110,100 @@ def test_openai_server_dataflow(tmp_path):
     assert result.is_ok(), result.errors()
     log_dir = next((tmp_path / "out").iterdir())
     assert "openai roundtrip ok" in (log_dir / "log_driver.txt").read_text()
+
+
+def test_openai_server_streaming(tmp_path):
+    """stream: true -> SSE chat.completion.chunk deltas; a responder that
+    answers in two messages streams two content deltas before [DONE]
+    (openai-proxy-server parity, src/main.rs:368-399)."""
+    responder = tmp_path / "split.py"
+    responder.write_text(textwrap.dedent("""
+        import pyarrow as pa
+
+        from dora_tpu.node import Node
+
+        with Node() as node:
+            for event in node:
+                if event["type"] == "INPUT":
+                    text = event["value"][0].as_py()
+                    for word in text.split():
+                        node.send_output("reply", pa.array([word.upper()]))
+                elif event["type"] == "STOP":
+                    break
+    """))
+    driver = tmp_path / "driver.py"
+    driver.write_text(textwrap.dedent("""
+        import json
+        import time
+        import urllib.request
+
+        from dora_tpu.node import Node
+
+        node = Node()  # participates so the dataflow keeps running
+        time.sleep(0.5)
+        body = json.dumps({
+            "model": "dora-tpu",
+            "stream": True,
+            "messages": [{"role": "user", "content": "hello world"}],
+        }).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:8131/v1/chat/completions",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        raw = None
+        last_err = None
+        for attempt in range(20):
+            try:
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    assert r.headers["Content-Type"] == "text/event-stream"
+                    raw = r.read().decode()
+                break
+            except Exception as e:
+                last_err = e
+                time.sleep(0.25)
+        assert raw is not None, f"no response after 20 attempts: {last_err}"
+        events = [
+            json.loads(line[6:])
+            for line in raw.splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"
+        ]
+        assert raw.rstrip().endswith("data: [DONE]")
+        deltas = [e["choices"][0]["delta"] for e in events]
+        content = "".join(d.get("content", "") for d in deltas)
+        assert content == "HELLOWORLD", content
+        assert deltas[0] == {"role": "assistant"}
+        assert events[-1]["choices"][0]["finish_reason"] == "stop"
+        assert all(e["object"] == "chat.completion.chunk" for e in events)
+        print("openai streaming ok")
+        node.close()
+    """))
+    spec = {
+        "nodes": [
+            {
+                "id": "api",
+                "path": "module:dora_tpu.nodehub.openai_server",
+                "outputs": ["text"],
+                "inputs": {"response": "split/reply"},
+                # Wide quiet window: under full-suite load the second
+                # chunk can lag the first by more than the 300 ms default.
+                "env": {
+                    "PORT": "8131",
+                    "MAX_REQUESTS": "1",
+                    "STREAM_QUIET_MS": "3000",
+                },
+            },
+            {
+                "id": "split",
+                "path": "split.py",
+                "inputs": {"text": "api/text"},
+                "outputs": ["reply"],
+            },
+            {"id": "driver", "path": "driver.py"},
+        ]
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    result = run_dataflow(df, timeout_s=120)
+    assert result.is_ok(), result.errors()
+    log_dir = next((tmp_path / "out").iterdir())
+    assert "openai streaming ok" in (log_dir / "log_driver.txt").read_text()
