@@ -269,7 +269,16 @@ def _start_log_stream(args, uuid: str):
 
 class _HotReloadWatcher:
     """mtime-poll Python operator sources of a dataflow
-    (reference: attach.rs file watcher -> Reload)."""
+    (reference: attach.rs file watcher -> Reload).
+
+    Scope is exact parity with the reference: *Python operators only*.
+    The reference deliberately excludes custom nodes ("Reloading Custom
+    Nodes is not supported", attach.rs:45-46) and non-Python operators
+    (attach.rs:59-60) — a custom node owns its process, so a mid-dataflow
+    code swap would really be a restart, with subscriptions/drop-token
+    state severed; the runtime-hosted Python operator is the one place a
+    live swap is sound (runtime/__init__.py preserves the instance
+    __dict__ across reloads)."""
 
     def __init__(self, dataflow_path: str, working_dir: str | None):
         from dora_tpu.core.descriptor import (

@@ -1,0 +1,20 @@
+// C++ operator using the RAII wrapper (reference:
+// examples/c++-dataflow operator half): counts inputs, emits the count.
+#include <string>
+
+#include "dora_operator_api.hpp"
+
+class Counter : public dora::Operator {
+  int count_ = 0;
+
+  dora::Status on_input(std::string_view, dora::Bytes data,
+                        dora::OutputSender& out) override {
+    ++count_;
+    std::string msg = "count=" + std::to_string(count_) +
+                      " bytes=" + std::to_string(data.len);
+    out.send("count", msg);
+    return dora::Status::Continue;
+  }
+};
+
+DORA_REGISTER_OPERATOR(Counter)
