@@ -1,0 +1,218 @@
+"""YOLOS-family object detection serving pretrained HF checkpoints.
+
+The reference's detection node serves pretrained ultralytics weights
+through torch (node-hub/dora-yolo/dora_yolo/main.py:37-104). The
+TPU-native pretrained counterpart is YOLOS (hustvl/yolos-tiny/-small/
+-base): a pure ViT whose extra "detection tokens" regress boxes — no
+anchors, no NMS, static shapes end to end, which is exactly the MXU
+shape. Faithful to transformers' `YolosForObjectDetection` graph
+(pre-LN ViT with qkv biases + GELU, cls/detection tokens, learned
+positions, optional per-layer mid position embeddings, 3-layer
+ReLU-MLP heads, sigmoid cxcywh boxes) — parity asserted in
+tests/test_hf_parity.py.
+
+Serving runs at the checkpoint's native resolution (position embeddings
+are used as stored; resize inputs to ``cfg.image_size`` first — the
+bicubic interpolation HF applies for other sizes is out of scope).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.hf.loader import (
+    linear,
+    read_config,
+    read_safetensors,
+)
+
+
+@dataclass(frozen=True)
+class YolosConfig:
+    dim: int
+    layers: int
+    heads: int
+    ffn: int
+    image_size: tuple[int, int]  # (H, W)
+    patch_size: int
+    n_det: int
+    n_labels: int  # real classes (logits have +1 no-object column)
+    layer_norm_eps: float
+    use_mid_pos: bool
+
+    @property
+    def n_patches(self) -> int:
+        h, w = self.image_size
+        return (h // self.patch_size) * (w // self.patch_size)
+
+    @classmethod
+    def from_hf(cls, config: dict) -> "YolosConfig":
+        size = config["image_size"]
+        if isinstance(size, int):
+            size = [size, size]
+        n_labels = config.get("num_labels")
+        if n_labels is None:
+            n_labels = len(config.get("id2label", {})) or 91
+        return cls(
+            dim=config["hidden_size"],
+            layers=config["num_hidden_layers"],
+            heads=config["num_attention_heads"],
+            ffn=config["intermediate_size"],
+            image_size=(int(size[0]), int(size[1])),
+            patch_size=config["patch_size"],
+            n_det=config.get("num_detection_tokens", 100),
+            n_labels=int(n_labels),
+            layer_norm_eps=config.get("layer_norm_eps", 1e-12),
+            use_mid_pos=config.get("use_mid_position_embeddings", True),
+        )
+
+
+def load(model_dir: str | Path):
+    """(config, params) from a HF YOLOS checkpoint directory."""
+    raw = read_config(model_dir)
+    cfg = YolosConfig.from_hf(raw)
+    tensors = read_safetensors(model_dir)
+    return cfg, map_params(tensors, cfg)
+
+
+def _mlp_head(tensors: dict, prefix: str) -> dict:
+    return {
+        str(i): {
+            "w": linear(tensors, f"{prefix}layers.{i}.weight"),
+            "b": tensors[f"{prefix}layers.{i}.bias"],
+        }
+        for i in range(3)
+    }
+
+
+def map_params(tensors: dict, cfg: YolosConfig) -> dict:
+    # Conv patch embed [dim, 3, ps, ps] -> dense over (i, j, c)-flattened
+    # patches (the layout models.vlm.patchify produces).
+    conv = tensors["vit.embeddings.patch_embeddings.projection.weight"]
+    patch_proj = np.ascontiguousarray(
+        conv.transpose(2, 3, 1, 0).reshape(-1, cfg.dim)
+    )
+
+    def layer(i: int) -> dict:
+        lp = f"vit.encoder.layer.{i}."
+        return {
+            "attn_norm": tensors[lp + "layernorm_before.weight"],
+            "attn_norm_b": tensors[lp + "layernorm_before.bias"],
+            "wq": linear(tensors, lp + "attention.attention.query.weight"),
+            "bq": tensors[lp + "attention.attention.query.bias"],
+            "wk": linear(tensors, lp + "attention.attention.key.weight"),
+            "bk": tensors[lp + "attention.attention.key.bias"],
+            "wv": linear(tensors, lp + "attention.attention.value.weight"),
+            "bv": tensors[lp + "attention.attention.value.bias"],
+            "wo": linear(tensors, lp + "attention.output.dense.weight"),
+            "bo": tensors[lp + "attention.output.dense.bias"],
+            "ffn_norm": tensors[lp + "layernorm_after.weight"],
+            "ffn_norm_b": tensors[lp + "layernorm_after.bias"],
+            "w_up": linear(tensors, lp + "intermediate.dense.weight"),
+            "b_up": tensors[lp + "intermediate.dense.bias"],
+            "w_down": linear(tensors, lp + "output.dense.weight"),
+            "b_down": tensors[lp + "output.dense.bias"],
+        }
+
+    params = {
+        "patch_proj": patch_proj,
+        "patch_bias": tensors["vit.embeddings.patch_embeddings.projection.bias"],
+        "cls_token": tensors["vit.embeddings.cls_token"][0],        # [1, dim]
+        "det_tokens": tensors["vit.embeddings.detection_tokens"][0],  # [n_det, dim]
+        "pos_embed": tensors["vit.embeddings.position_embeddings"][0],
+        "blocks": {str(i): layer(i) for i in range(cfg.layers)},
+        "out_norm": tensors["vit.layernorm.weight"],
+        "out_norm_b": tensors["vit.layernorm.bias"],
+        "class_head": _mlp_head(tensors, "class_labels_classifier."),
+        "bbox_head": _mlp_head(tensors, "bbox_predictor."),
+    }
+    mid = tensors.get("vit.encoder.mid_position_embeddings")
+    if cfg.use_mid_pos and mid is not None and mid.shape[0] > 0:
+        params["mid_pos"] = mid[:, 0]  # [layers-1, seq, dim]
+    return params
+
+
+def _run_head(head: dict, x, dtype):
+    for i in range(3):
+        x = x @ head[str(i)]["w"].astype(dtype) + head[str(i)]["b"].astype(dtype)
+        if i < 2:
+            x = jax.nn.relu(x)
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def forward(params, cfg: YolosConfig, pixel_values):
+    """pixel_values [B, 3, H, W] (HF normalization applied) ->
+    (logits [B, n_det, n_labels+1], boxes [B, n_det, 4] cxcywh in [0,1])."""
+    from dora_tpu.models.vlm import patchify
+
+    dtype = L.compute_dtype()
+    b = pixel_values.shape[0]
+    images = jnp.transpose(pixel_values, (0, 2, 3, 1))  # -> [B, H, W, 3]
+    x = patchify(images.astype(dtype), cfg.patch_size)
+    x = x @ params["patch_proj"].astype(dtype) + params["patch_bias"].astype(dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(dtype), (b, 1, cfg.dim))
+    det = jnp.broadcast_to(
+        params["det_tokens"].astype(dtype), (b, cfg.n_det, cfg.dim)
+    )
+    x = jnp.concatenate([cls, x, det], axis=1)
+    x = x + params["pos_embed"].astype(dtype)[None]
+
+    for i in range(cfg.layers):
+        x, _ = L.block_forward(
+            params["blocks"][str(i)], x, cfg.heads, mask=None,
+            norm="ln", mlp="gelu", norm_eps=cfg.layer_norm_eps,
+        )
+        if "mid_pos" in params and i < cfg.layers - 1:
+            x = x + params["mid_pos"][i].astype(dtype)[None]
+
+    x = L.layer_norm(
+        x, params["out_norm"], params["out_norm_b"], eps=cfg.layer_norm_eps
+    )
+    det_out = x[:, -cfg.n_det :].astype(jnp.float32)
+    logits = _run_head(params["class_head"], det_out, jnp.float32)
+    boxes = jax.nn.sigmoid(_run_head(params["bbox_head"], det_out, jnp.float32))
+    return logits, boxes
+
+
+@partial(jax.jit, static_argnums=(1, 4))
+def detect(params, cfg: YolosConfig, pixel_values, threshold, top_k: int = 100):
+    """Post-processed detections (HF post_process_object_detection
+    semantics): softmax over classes, drop the trailing no-object column,
+    keep scores above ``threshold``; boxes as normalized xyxy. Static
+    shapes: returns exactly ``top_k`` rows, padded with score 0."""
+    logits, boxes = forward(params, cfg, pixel_values)
+    probs = jax.nn.softmax(logits, axis=-1)[..., :-1]
+    scores = jnp.max(probs, axis=-1)
+    classes = jnp.argmax(probs, axis=-1)
+    scores = jnp.where(scores >= threshold, scores, 0.0)
+    cx, cy, w, h = jnp.moveaxis(boxes, -1, 0)
+    xyxy = jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1
+    )
+    top_k = min(top_k, scores.shape[-1])
+    top_scores, idx = jax.lax.top_k(scores, top_k)
+    return {
+        "scores": top_scores,
+        "classes": jnp.take_along_axis(classes, idx, axis=1),
+        "boxes": jnp.take_along_axis(xyxy, idx[..., None], axis=1),
+    }
+
+
+#: ImageNet normalization the HF YolosImageProcessor applies.
+IMAGE_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGE_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def preprocess(images, cfg: YolosConfig):
+    """[B, H, W, 3] float in [0, 1] (already at cfg.image_size) ->
+    normalized [B, 3, H, W]."""
+    x = (images - IMAGE_MEAN) / IMAGE_STD
+    return jnp.transpose(jnp.asarray(x, jnp.float32), (0, 3, 1, 2))

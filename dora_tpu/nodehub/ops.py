@@ -59,8 +59,50 @@ def _maybe_cast(params):
 
 
 def make_detector() -> JaxOperator:
-    """Image [H,W,3] float in [0,1] -> boxes/scores/classes (fixed K)."""
+    """Image [H,W,3] float in [0,1] -> boxes/scores/classes (fixed K).
+
+    With DORA_HF_CHECKPOINT pointing at a YOLOS safetensors directory,
+    serves the real pretrained detector (reference parity: dora-yolo
+    serving ultralytics weights, dora_yolo/main.py:37-104); image must
+    arrive at the checkpoint's native resolution.
+    """
     from dora_tpu.models import detection
+
+    hf_path = _hf_checkpoint("yolos")
+    if hf_path:
+        from dora_tpu.models.hf import yolos
+
+        cfg, params = yolos.load(hf_path)
+        params = _maybe_cast(params)
+        threshold = float(os.environ.get("DORA_DETECT_THRESHOLD", "0.5"))
+        top_k = int(os.environ.get("DORA_DETECT_TOPK", str(cfg.n_det)))
+
+        def hf_step(state, inputs):
+            import jax.numpy as jnp
+
+            image = _normalize(inputs["image"])[None]
+            pixels = yolos.preprocess(image, cfg)
+            out = yolos.detect(state, cfg, pixels, threshold, top_k)
+            # Operator contract (shared with the self-contained detector,
+            # consumed by nodehub/plot.py): absolute-pixel cxcywh.
+            x1, y1, x2, y2 = jnp.moveaxis(out["boxes"][0], -1, 0)
+            img_h, img_w = cfg.image_size
+            boxes = jnp.stack(
+                [
+                    (x1 + x2) / 2 * img_w,
+                    (y1 + y2) / 2 * img_h,
+                    (x2 - x1) * img_w,
+                    (y2 - y1) * img_h,
+                ],
+                axis=-1,
+            )
+            return state, {
+                "boxes": boxes,
+                "scores": out["scores"][0],
+                "classes": out["classes"][0],
+            }
+
+        return JaxOperator(step=hf_step, init_state=params)
 
     cfg = (
         detection.DetectorConfig.tiny()

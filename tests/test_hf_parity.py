@@ -549,6 +549,103 @@ def test_vlm_operator_serves_hf_checkpoint(qwen2vl_checkpoint, monkeypatch):
     np.testing.assert_array_equal(tokens[None], theirs)
 
 
+# ---------------------------------------------------------------------------
+# YOLOS object detection
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def yolos_checkpoint(tmp_path_factory):
+    from transformers import YolosConfig, YolosForObjectDetection
+
+    config = YolosConfig(
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        image_size=[32, 48],
+        patch_size=8,
+        num_detection_tokens=5,
+        num_labels=7,
+        qkv_bias=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(17)
+    model = YolosForObjectDetection(config).eval()
+    path = tmp_path_factory.mktemp("yolos-tiny")
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def test_yolos_logits_and_boxes_match_torch(yolos_checkpoint):
+    from dora_tpu.models.hf import yolos
+
+    path, torch_model = yolos_checkpoint
+    cfg, params = yolos.load(path)
+    assert cfg.image_size == (32, 48) and cfg.n_det == 5
+
+    rng = np.random.default_rng(18)
+    pixels = rng.normal(size=(2, 3, 32, 48)).astype(np.float32)
+    logits, boxes = yolos.forward(params, cfg, pixels)
+    with torch.no_grad():
+        out = torch_model(pixel_values=torch.tensor(pixels))
+    np.testing.assert_allclose(
+        np.asarray(logits), out.logits.numpy(), atol=3e-4, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(boxes), out.pred_boxes.numpy(), atol=3e-4, rtol=2e-3
+    )
+
+
+def test_yolos_detect_matches_hf_postprocess(yolos_checkpoint):
+    from transformers.models.yolos.image_processing_yolos import (
+        YolosImageProcessor,
+    )
+
+    from dora_tpu.models.hf import yolos
+
+    path, torch_model = yolos_checkpoint
+    cfg, params = yolos.load(path)
+    rng = np.random.default_rng(19)
+    pixels = rng.normal(size=(1, 3, 32, 48)).astype(np.float32)
+
+    ours = yolos.detect(params, cfg, pixels, threshold=0.0, top_k=5)
+    with torch.no_grad():
+        out = torch_model(pixel_values=torch.tensor(pixels))
+    proc = YolosImageProcessor()
+    hf = proc.post_process_object_detection(
+        out, threshold=0.0, target_sizes=[(1.0, 1.0)]
+    )[0]
+    order = np.argsort(-hf["scores"].numpy(), kind="stable")
+    np.testing.assert_allclose(
+        np.asarray(ours["scores"][0]), hf["scores"].numpy()[order],
+        atol=1e-4, rtol=1e-3,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ours["classes"][0]), hf["labels"].numpy()[order]
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours["boxes"][0]), hf["boxes"].numpy()[order],
+        atol=3e-4, rtol=2e-3,
+    )
+
+
+def test_detector_operator_serves_hf_checkpoint(yolos_checkpoint, monkeypatch):
+    from dora_tpu.nodehub import ops
+
+    path, _ = yolos_checkpoint
+    monkeypatch.setenv("DORA_HF_CHECKPOINT", str(path))
+    monkeypatch.setenv("DORA_DETECT_THRESHOLD", "0.0")
+
+    op = ops.make_detector()
+    rng = np.random.default_rng(20)
+    image = rng.integers(0, 256, size=(32, 48, 3)).astype(np.uint8)
+    _, out = op.step(op.init_state, {"image": jnp.asarray(image)})
+    assert np.asarray(out["boxes"]).shape == (5, 4)
+    assert np.asarray(out["scores"]).shape == (5,)
+    assert np.asarray(out["classes"]).shape == (5,)
+
+
 def test_asr_operator_serves_hf_checkpoint(whisper_checkpoint, monkeypatch):
     from dora_tpu.nodehub import ops
 
