@@ -148,14 +148,14 @@ def _run_head(head: dict, x, dtype):
 
 
 @partial(jax.jit, static_argnums=(1,))
-def forward(params, cfg: YolosConfig, pixel_values):
-    """pixel_values [B, 3, H, W] (HF normalization applied) ->
+def forward(params, cfg: YolosConfig, images):
+    """images [B, H, W, 3] (HF normalization applied; JAX-native NHWC —
+    use :func:`nchw` to adapt torch-layout inputs) ->
     (logits [B, n_det, n_labels+1], boxes [B, n_det, 4] cxcywh in [0,1])."""
     from dora_tpu.models.vlm import patchify
 
     dtype = L.compute_dtype()
-    b = pixel_values.shape[0]
-    images = jnp.transpose(pixel_values, (0, 2, 3, 1))  # -> [B, H, W, 3]
+    b = images.shape[0]
     x = patchify(images.astype(dtype), cfg.patch_size)
     x = x @ params["patch_proj"].astype(dtype) + params["patch_bias"].astype(dtype)
     cls = jnp.broadcast_to(params["cls_token"].astype(dtype), (b, 1, cfg.dim))
@@ -183,12 +183,13 @@ def forward(params, cfg: YolosConfig, pixel_values):
 
 
 @partial(jax.jit, static_argnums=(1, 4))
-def detect(params, cfg: YolosConfig, pixel_values, threshold, top_k: int = 100):
+def detect(params, cfg: YolosConfig, images, threshold, top_k: int = 100):
     """Post-processed detections (HF post_process_object_detection
     semantics): softmax over classes, drop the trailing no-object column,
     keep scores above ``threshold``; boxes as normalized xyxy. Static
-    shapes: returns exactly ``top_k`` rows, padded with score 0."""
-    logits, boxes = forward(params, cfg, pixel_values)
+    shapes: returns exactly ``top_k`` rows, padded with score 0.
+    ``images``: NHWC, normalized (see :func:`preprocess`)."""
+    logits, boxes = forward(params, cfg, images)
     probs = jax.nn.softmax(logits, axis=-1)[..., :-1]
     scores = jnp.max(probs, axis=-1)
     classes = jnp.argmax(probs, axis=-1)
@@ -213,6 +214,10 @@ IMAGE_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 def preprocess(images, cfg: YolosConfig):
     """[B, H, W, 3] float in [0, 1] (already at cfg.image_size) ->
-    normalized [B, 3, H, W]."""
-    x = (images - IMAGE_MEAN) / IMAGE_STD
-    return jnp.transpose(jnp.asarray(x, jnp.float32), (0, 3, 1, 2))
+    normalized NHWC (layout preserved — no torch-style NCHW round trip)."""
+    return jnp.asarray((images - IMAGE_MEAN) / IMAGE_STD, jnp.float32)
+
+
+def nchw(pixel_values):
+    """Adapt torch-layout [B, 3, H, W] inputs (parity tests) to NHWC."""
+    return jnp.transpose(jnp.asarray(pixel_values), (0, 2, 3, 1))

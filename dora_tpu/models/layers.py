@@ -271,7 +271,10 @@ def mlp_sublayer(params, x, *, norm="rms", mlp="swiglu", norm_eps=1e-6):
 
 
 #: Tensor-parallel sharding rules for block parameters (Megatron layout):
-#: column-parallel for q/k/v/gate/up, row-parallel for o/down.
+#: column-parallel for q/k/v/gate/up, row-parallel for o/down. Names are
+#: exact leaf names (see parallel.mesh.shard_params) — cross-attention
+#: projections get their own entries, and position tables / norms fall to
+#: the replicated default.
 def tp_rules():
     from jax.sharding import PartitionSpec as P
 
@@ -280,6 +283,10 @@ def tp_rules():
         ("wk", P(None, "tp")),
         ("wv", P(None, "tp")),
         ("wo", P("tp", None)),
+        ("x_wq", P(None, "tp")),
+        ("x_wk", P(None, "tp")),
+        ("x_wv", P(None, "tp")),
+        ("x_wo", P("tp", None)),
         ("w_gate", P(None, "tp")),
         ("w_up", P(None, "tp")),
         ("w_down", P("tp", None)),

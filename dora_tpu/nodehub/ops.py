@@ -24,6 +24,15 @@ def _size() -> str:
     return os.environ.get("DORA_MODEL_SIZE", "tiny")
 
 
+def _tp_sharding():
+    """Megatron tensor-parallel placement rules for transformer weights —
+    applied by the fused executor when the runtime serves on a DORA_MESH
+    (dora_tpu.tpu.fuse.mesh_from_env); a no-op without a mesh."""
+    from dora_tpu.models.layers import tp_rules
+
+    return tp_rules()
+
+
 def _normalize(image):
     """uint8 camera frames -> float in [0,1]; float frames pass through."""
     import jax.numpy as jnp
@@ -197,7 +206,9 @@ def make_vlm() -> JaxOperator:
             tokens = serve(state, _normalize(inputs["image"]))
             return state, {"tokens": tokens[0]}
 
-        return JaxOperator(step=hf_step, init_state=params)
+        return JaxOperator(
+            step=hf_step, init_state=params, sharding=_tp_sharding()
+        )
 
     cfg = vlm.VLMConfig.tiny() if _size() == "tiny" else vlm.VLMConfig.bench_2b()
     params = _maybe_restore(vlm.init_params(jax.random.PRNGKey(0), cfg), "vlm")
@@ -212,7 +223,7 @@ def make_vlm() -> JaxOperator:
         tokens = vlm.generate(state, cfg, image, prompt, max_new)
         return state, {"tokens": tokens[0]}
 
-    return JaxOperator(step=step, init_state=params)
+    return JaxOperator(step=step, init_state=params, sharding=_tp_sharding())
 
 
 def make_asr() -> JaxOperator:
@@ -235,7 +246,9 @@ def make_asr() -> JaxOperator:
             tokens = serve(state, inputs["audio"])
             return state, {"tokens": tokens[0]}
 
-        return JaxOperator(step=hf_step, init_state=params)
+        return JaxOperator(
+            step=hf_step, init_state=params, sharding=_tp_sharding()
+        )
 
     cfg = asr.ASRConfig.tiny() if _size() == "tiny" else asr.ASRConfig()
     params = _maybe_restore(asr.init_params(jax.random.PRNGKey(0), cfg), "asr")
@@ -249,7 +262,7 @@ def make_asr() -> JaxOperator:
         tokens = asr.transcribe(state, cfg, audio, bos, max_new)
         return state, {"tokens": tokens[0]}
 
-    return JaxOperator(step=step, init_state=params)
+    return JaxOperator(step=step, init_state=params, sharding=_tp_sharding())
 
 
 def make_translator() -> JaxOperator:
@@ -288,7 +301,7 @@ def make_translator() -> JaxOperator:
         tokens = translation.translate(state, cfg, src[None], bos, max_new)
         return state, {"tokens": tokens[0]}
 
-    return JaxOperator(step=step, init_state=params)
+    return JaxOperator(step=step, init_state=params, sharding=_tp_sharding())
 
 
 def make_tts() -> JaxOperator:

@@ -49,17 +49,35 @@ def shard(x, mesh, *spec):
 def shard_params(params: Any, mesh, rules) -> Any:
     """Place a parameter pytree on the mesh.
 
-    ``rules`` maps a path-suffix predicate to a PartitionSpec: a list of
-    ``(match, spec)`` where ``match`` is a substring of the '/'-joined
-    parameter path. First match wins; default is full replication.
+    ``rules`` is a list of ``(name, spec)`` matched against the leaf's
+    FINAL path component exactly (substring matching would silently catch
+    look-alikes — 'embed' must not shard 'pos_embed'). First match wins;
+    default is full replication. A matched leaf whose dimension does not
+    divide the mesh axis falls back to replication instead of crashing —
+    real checkpoint shapes (odd vocab sizes, 196-patch position tables)
+    must serve on any mesh.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def divisible(leaf, spec) -> bool:
+        shape = getattr(leaf, "shape", ())
+        for dim, axes in zip(shape, tuple(spec) + (None,) * len(shape)):
+            if axes is None:
+                continue
+            for axis in (axes if isinstance(axes, tuple) else (axes,)):
+                if dim % axis_sizes.get(axis, 1):
+                    return False
+        return True
+
     def place(path, leaf):
-        path_str = "/".join(str(getattr(k, "key", k)) for k in path)
+        name = str(getattr(path[-1], "key", path[-1])) if path else ""
         for match, spec in rules:
-            if match in path_str:
+            if name == match:
+                if not divisible(leaf, spec):
+                    break  # replicate: shape does not tile on this mesh
                 return jax.device_put(leaf, NamedSharding(mesh, spec))
         return jax.device_put(leaf, NamedSharding(mesh, PartitionSpec()))
 

@@ -188,26 +188,73 @@ def _topo_sort(op_ids: list[str], intra: dict[tuple[str, str], tuple[str, str]])
     return order
 
 
+def mesh_from_env():
+    """Device mesh from ``DORA_MESH`` ("tp=4" / "dp=2,tp=2,sp=2"), or None.
+
+    Multi-chip serving inside one runtime node (SURVEY §2.9 "pjit-sharded
+    ops within a node"): the fused step jits over this mesh, operator
+    states place per their sharding rules, and XLA inserts the
+    collectives over ICI.
+    """
+    import os
+
+    spec = os.environ.get("DORA_MESH", "").strip()
+    if not spec:
+        return None
+    from dora_tpu.parallel.mesh import make_mesh
+
+    axes = {"dp": 1, "tp": 1, "sp": 1}
+    for part in spec.split(","):
+        name, _, value = part.partition("=")
+        name = name.strip()
+        if name not in axes:
+            raise ValueError(f"DORA_MESH: unknown axis {name!r} in {spec!r}")
+        axes[name] = int(value)
+    return make_mesh(**axes)
+
+
 class FusedExecutor:
     """Runtime driver of one fused graph: latest-wins input sampling, tick
-    triggering, jit with state donation."""
+    triggering, jit with state donation — over a device mesh when
+    ``DORA_MESH`` is set (operator ``sharding`` rules place the state)."""
 
-    def __init__(self, graph: FusedGraph):
+    def __init__(self, graph: FusedGraph, mesh=None):
         import jax
 
         self.graph = graph
-        self.states = {
-            op_id: jax.device_put(op.init_state)
-            for op_id, op in graph.operators.items()
-        }
+        self.mesh = mesh if mesh is not None else mesh_from_env()
+        self.states = {}
+        for op_id, op in graph.operators.items():
+            if self.mesh is not None and op.sharding is not None:
+                from dora_tpu.parallel.mesh import shard_params
+
+                self.states[op_id] = shard_params(
+                    op.init_state, self.mesh, op.sharding
+                )
+            else:
+                self.states[op_id] = jax.device_put(op.init_state)
         #: latest device value per external data input (latest-wins sampling)
         self.latest: dict[str, Any] = {}
         self._compiled_once = False
         # Donate state so it is updated in place in HBM; on CPU donation is
         # unimplemented and only produces warnings, so skip it there.
         donate = (0,) if jax.default_backend() in ("tpu", "gpu") else ()
-        self._jit = jax.jit(graph.step_fn, donate_argnums=donate)
+        step_fn = graph.step_fn
+        if self.mesh is not None:
+            step_fn = self._meshed(step_fn)
+        self._jit = jax.jit(step_fn, donate_argnums=donate)
         self._required = graph.external_inputs - graph.timer_inputs
+
+    def _meshed(self, step_fn):
+        """Run the step inside the mesh context so with_sharding_constraint
+        in operator code resolves axis names."""
+        import jax
+
+        def run(states, latest):
+            with self.mesh:
+                return step_fn(states, latest)
+
+        return run
 
     def observe(self, event_id: str, value, metadata: dict | None) -> None:
         """Record an input's latest value without ticking. Non-trigger

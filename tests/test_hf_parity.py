@@ -586,7 +586,7 @@ def test_yolos_logits_and_boxes_match_torch(yolos_checkpoint):
 
     rng = np.random.default_rng(18)
     pixels = rng.normal(size=(2, 3, 32, 48)).astype(np.float32)
-    logits, boxes = yolos.forward(params, cfg, pixels)
+    logits, boxes = yolos.forward(params, cfg, yolos.nchw(pixels))
     with torch.no_grad():
         out = torch_model(pixel_values=torch.tensor(pixels))
     np.testing.assert_allclose(
@@ -609,7 +609,7 @@ def test_yolos_detect_matches_hf_postprocess(yolos_checkpoint):
     rng = np.random.default_rng(19)
     pixels = rng.normal(size=(1, 3, 32, 48)).astype(np.float32)
 
-    ours = yolos.detect(params, cfg, pixels, threshold=0.0, top_k=5)
+    ours = yolos.detect(params, cfg, yolos.nchw(pixels), threshold=0.0, top_k=5)
     with torch.no_grad():
         out = torch_model(pixel_values=torch.tensor(pixels))
     proc = YolosImageProcessor()

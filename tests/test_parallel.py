@@ -69,3 +69,30 @@ def test_shard_params_rules():
     assert placed["blocks"]["0"]["attn_q"].sharding.spec == P(None, "tp")
     assert placed["embed"].sharding.spec == P("tp", None)
     assert placed["blocks"]["0"]["norm"].sharding.spec == P()
+
+
+def test_shard_params_exact_leaf_name_not_substring():
+    """'embed' must not catch 'pos_embed' — position tables replicate."""
+    mesh = make_mesh(dp=2, tp=4, sp=1)
+    params = {
+        "embed": jnp.ones((32, 16)),
+        "vision": {"pos_embed": jnp.ones((196, 16))},
+    }
+    placed = shard_params(params, mesh, [("embed", P("tp", None))])
+    assert placed["embed"].sharding.spec == P("tp", None)
+    assert placed["vision"]["pos_embed"].sharding.spec == P()
+
+
+def test_shard_params_indivisible_falls_back_to_replication():
+    """Real checkpoint shapes (odd vocab, 196 patches) must serve on any
+    mesh: a non-tiling dimension replicates instead of crashing."""
+    mesh = make_mesh(dp=1, tp=8, sp=1)
+    params = {
+        "embed": jnp.ones((51865, 16)),  # whisper vocab: odd
+        "w_up": jnp.ones((16, 64)),      # divides: shards normally
+    }
+    placed = shard_params(
+        params, mesh, [("embed", P("tp", None)), ("w_up", P(None, "tp"))]
+    )
+    assert placed["embed"].sharding.spec == P()
+    assert placed["w_up"].sharding.spec == P(None, "tp")
