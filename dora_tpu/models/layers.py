@@ -167,6 +167,7 @@ def block_forward(
     norm_eps: float = 1e-6,
     head_dim: int | None = None,
     flash: str | None = None,
+    sp_impl: str | None = None,
 ):
     """One pre-norm block. Returns (y, new_cache).
 
@@ -184,7 +185,7 @@ def block_forward(
         params, x, n_heads, n_kv_heads=n_kv_heads, rope=rope,
         positions=positions, rope_tables=rope_tables, mask=mask, cache=cache,
         cache_index=cache_index, mesh=mesh, ring_axis=ring_axis, norm=norm,
-        norm_eps=norm_eps, head_dim=head_dim, flash=flash,
+        norm_eps=norm_eps, head_dim=head_dim, flash=flash, sp_impl=sp_impl,
     )
     x = mlp_sublayer(params, x, norm=norm, mlp=mlp, norm_eps=norm_eps)
     return x, new_cache
@@ -194,6 +195,7 @@ def attention_sublayer(
     params, x, n_heads, *, n_kv_heads=None, rope=None, positions=None,
     rope_tables=None, mask=None, cache=None, cache_index=None, mesh=None,
     ring_axis=None, norm="rms", norm_eps=1e-6, head_dim=None, flash=None,
+    sp_impl=None,
 ):
     """Pre-norm self-attention with residual. Returns (y, new_cache).
 
@@ -243,9 +245,17 @@ def attention_sublayer(
         v = jnp.repeat(v, rep, axis=1)
 
     if ring_axis is not None and mesh is not None:
-        from dora_tpu.parallel.ring import ring_attention
+        causal = mask is not None
+        if sp_impl == "ulysses":
+            from dora_tpu.parallel.ulysses import ulysses_attention
 
-        out = ring_attention(q, k, v, mesh, causal=mask is not None, axis=ring_axis)
+            out = ulysses_attention(q, k, v, mesh, causal=causal, axis=ring_axis)
+        elif sp_impl in (None, "ring"):
+            from dora_tpu.parallel.ring import ring_attention
+
+            out = ring_attention(q, k, v, mesh, causal=causal, axis=ring_axis)
+        else:
+            raise ValueError(f"unknown sp_impl {sp_impl!r} (ring | ulysses)")
     elif flash is not None and cache is None:
         from dora_tpu.ops import flash_attention
 

@@ -150,7 +150,7 @@ def encode_image(params, cfg: VLMConfig, images):
 
 def _lm_forward(
     params, cfg: VLMConfig, h, positions, mask, caches=None, cache_index=None,
-    mesh=None, ring_axis=None, flash=None,
+    mesh=None, ring_axis=None, flash=None, sp_impl=None,
 ):
     rope = L.rope_table(cfg.max_seq, cfg.head_dim)
     new_caches = {}
@@ -168,6 +168,7 @@ def _lm_forward(
             mesh=mesh,
             ring_axis=ring_axis,
             flash=flash,
+            sp_impl=sp_impl,
         )
         if new_cache is not None:
             new_caches[str(i)] = new_cache
@@ -251,7 +252,8 @@ def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
 # ---------------------------------------------------------------------------
 
 
-def loss_fn(params, cfg: VLMConfig, batch, mesh=None, ring_axis=None):
+def loss_fn(params, cfg: VLMConfig, batch, mesh=None, ring_axis=None,
+            sp_impl=None):
     """Next-token cross-entropy on the text portion, image tokens prefixed.
 
     batch: {"images": [B,H,W,3], "tokens": [B,T] int32}; predicts tokens
@@ -268,7 +270,7 @@ def loss_fn(params, cfg: VLMConfig, batch, mesh=None, ring_axis=None):
     flash = "causal" if L.use_flash() and not ring_axis else None
     h, _ = _lm_forward(
         params, cfg, h, positions, L.causal_mask(seq, seq),
-        mesh=mesh, ring_axis=ring_axis, flash=flash,
+        mesh=mesh, ring_axis=ring_axis, flash=flash, sp_impl=sp_impl,
     )
     # Score only text positions: logits at [P-1 .. P+T-2] predict tokens.
     p = cfg.n_patches
@@ -279,13 +281,20 @@ def loss_fn(params, cfg: VLMConfig, batch, mesh=None, ring_axis=None):
     return jnp.mean(nll)
 
 
-def make_train_step(cfg: VLMConfig, optimizer, mesh=None, ring_axis=None):
+def make_train_step(cfg: VLMConfig, optimizer, mesh=None, ring_axis=None,
+                    sp_impl=None):
     """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss).
 
     With a mesh: batch sharded over dp (and sequence over sp when
     ring_axis is set); parameters follow the Megatron tp rules; XLA
-    inserts the gradient psum from the shardings.
+    inserts the gradient psum from the shardings. ``sp_impl`` picks the
+    sequence-parallel strategy ("ring" | "ulysses"); unset, it resolves
+    from DORA_SP_IMPL here, once, at step construction.
     """
+    if sp_impl is None:
+        import os
+
+        sp_impl = os.environ.get("DORA_SP_IMPL", "ring")
 
     def train_step(params, opt_state, batch):
         if mesh is not None:
@@ -301,7 +310,8 @@ def make_train_step(cfg: VLMConfig, optimizer, mesh=None, ring_axis=None):
                 ),
             }
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, cfg, batch, mesh=mesh, ring_axis=ring_axis
+            params, cfg, batch, mesh=mesh, ring_axis=ring_axis,
+            sp_impl=sp_impl,
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)

@@ -111,7 +111,9 @@ def make_detector() -> JaxOperator:
                 "classes": out["classes"][0],
             }
 
-        return JaxOperator(step=hf_step, init_state=params)
+        return JaxOperator(
+            step=hf_step, init_state=params, sharding=_tp_sharding()
+        )
 
     cfg = (
         detection.DetectorConfig.tiny()
@@ -132,7 +134,7 @@ def make_detector() -> JaxOperator:
             "classes": out["classes"][0],
         }
 
-    return JaxOperator(step=step, init_state=params)
+    return JaxOperator(step=step, init_state=params, sharding=_tp_sharding())
 
 
 def _hf_checkpoint(model_type_prefix: str) -> str | None:

@@ -22,6 +22,7 @@ from dora_tpu.parallel.mesh import (
     shard_params,
 )
 from dora_tpu.parallel.ring import ring_attention
+from dora_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "AXIS_DP",
@@ -31,4 +32,5 @@ __all__ = [
     "shard",
     "shard_params",
     "ring_attention",
+    "ulysses_attention",
 ]

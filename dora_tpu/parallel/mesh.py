@@ -67,9 +67,12 @@ def shard_params(params: Any, mesh, rules) -> Any:
         for dim, axes in zip(shape, tuple(spec) + (None,) * len(shape)):
             if axes is None:
                 continue
+            # A dimension splits over the PRODUCT of its mesh axes.
+            total = 1
             for axis in (axes if isinstance(axes, tuple) else (axes,)):
-                if dim % axis_sizes.get(axis, 1):
-                    return False
+                total *= axis_sizes.get(axis, 1)
+            if dim % total:
+                return False
         return True
 
     def place(path, leaf):

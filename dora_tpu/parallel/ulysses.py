@@ -1,0 +1,76 @@
+"""Ulysses sequence parallelism: all-to-all head redistribution.
+
+The second long-context strategy next to ring attention (SURVEY §5.7 /
+§2.9; "DeepSpeed Ulysses", see PAPERS.md): with the sequence sharded
+over ``sp``, two ``all_to_all`` exchanges turn the layout
+[seq/sp, heads] → [seq, heads/sp] so every device runs *dense* attention
+over the full sequence for its head slice, then back. Communication is
+two all-to-alls of the activations (O(T·D/sp) per device, independent of
+T²) instead of ring's sp-step K/V rotation — cheaper when heads ≥ sp
+and the per-device full-sequence score matrix fits, while ring wins at
+extreme lengths. Both are exact; tests assert parity with dense
+attention on the virtual mesh.
+
+Constraint: ``heads % sp == 0`` (the head axis is what gets scattered).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dora_tpu.parallel.mesh import AXIS_SP
+
+
+def ulysses_attention(q, k, v, mesh, causal: bool = True, axis: str = AXIS_SP):
+    """Exact (optionally causal) attention with q/k/v sharded on ``axis``
+    along the sequence dimension; [batch, heads, seq, head_dim].
+
+    all_to_all #1 gathers the full sequence while scattering heads;
+    dense attention runs per head slice; all_to_all #2 restores the
+    sequence sharding.
+    """
+    sp = mesh.shape[axis]
+    b, h, t_local, d = q.shape
+    if sp == 1:
+        return _dense(q, k, v, causal, 0)
+    if h % sp:
+        raise ValueError(f"ulysses: heads={h} not divisible by sp={sp}")
+
+    def local(q, k, v):
+        # [B, h, T/sp, D] -> [B, h/sp, T, D]: scatter heads, gather seq.
+        def gather_seq(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        def scatter_seq(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        qg, kg, vg = gather_seq(q), gather_seq(k), gather_seq(v)
+        out = _dense(qg, kg, vg, causal, 0)
+        return scatter_seq(out)
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def _dense(q, k, v, causal: bool, offset: int):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        q.shape[-1]
+    ).astype(q.dtype)
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        qi = jnp.arange(tq)[:, None] + offset
+        ki = jnp.arange(tk)[None, :]
+        scores = jnp.where(
+            (qi >= ki)[None, None], scores, jnp.finfo(scores.dtype).min
+        )
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

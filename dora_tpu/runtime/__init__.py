@@ -131,6 +131,14 @@ def run() -> int:
     has_jax = any(
         isinstance(op.source, JaxSource) for op in me.kind.operators
     )
+    if has_jax:
+        # Multi-host tensor plane (SURVEY §2.9): when the deployment sets
+        # the DORA_JAX_* contract, this runtime joins the global mesh
+        # (one runtime node per TPU host) before any operator loads, so
+        # DORA_MESH sharding spans hosts — ICI within a slice, DCN across.
+        from dora_tpu.parallel.distributed import maybe_init_distributed
+
+        maybe_init_distributed()
     for op in me.kind.operators:
         if isinstance(op.source, WasmSource):
             # Reference parity: declared, not runnable

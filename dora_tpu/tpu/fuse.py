@@ -203,13 +203,17 @@ def mesh_from_env():
         return None
     from dora_tpu.parallel.mesh import make_mesh
 
-    axes = {"dp": 1, "tp": 1, "sp": 1}
+    # Unspecified dp absorbs the remaining devices, so "tp=4" just works
+    # on any host (make_mesh resolves dp=-1).
+    axes = {"dp": None, "tp": 1, "sp": 1}
     for part in spec.split(","):
         name, _, value = part.partition("=")
         name = name.strip()
         if name not in axes:
             raise ValueError(f"DORA_MESH: unknown axis {name!r} in {spec!r}")
         axes[name] = int(value)
+    if axes["dp"] is None:
+        axes["dp"] = -1
     return make_mesh(**axes)
 
 

@@ -96,3 +96,60 @@ def test_shard_params_indivisible_falls_back_to_replication():
     )
     assert placed["embed"].sharding.spec == P()
     assert placed["w_up"].sharding.spec == P(None, "tp")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    """All-to-all sequence parallelism (the second SP strategy next to
+    ring): heads scatter, sequence gathers, dense attention per head
+    slice — exact parity with dense attention."""
+    from dora_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    b, h, t, d = 2, 8, 64, 16
+    key = jax.random.PRNGKey(42)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, h, t, d))
+    v = jax.random.normal(kv, (b, h, t, d))
+
+    spec = P(None, None, "sp", None)
+    qs, ks, vs = (shard(x, mesh, *spec) for x in (q, k, v))
+    got = ulysses_attention(qs, ks, vs, mesh, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(reference_attention(q, k, v, causal)),
+        atol=2e-5,
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from dora_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    q = jnp.zeros((1, 6, 64, 8))  # 6 heads over sp=8
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, q, q, mesh)
+
+
+def test_ulysses_single_device_mesh():
+    from dora_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh(dp=8, tp=1, sp=1)
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 16, 8))
+    out = ulysses_attention(q, q, q, mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(reference_attention(q, q, q, True)),
+        atol=2e-5,
+    )
+
+
+def test_shard_params_tuple_axes_divisibility_uses_product():
+    """A dimension split over ('dp','tp') must divide their PRODUCT;
+    per-axis checks would wrongly pass dim=4 on a dp=4,tp=2 mesh."""
+    mesh = make_mesh(dp=4, tp=2, sp=1)
+    params = {"w": jnp.ones((4, 16))}
+    placed = shard_params(params, mesh, [("w", P(("dp", "tp"), None))])
+    assert placed["w"].sharding.spec == P()  # replicated, not crashed
+    params = {"w": jnp.ones((8, 16))}
+    placed = shard_params(params, mesh, [("w", P(("dp", "tp"), None))])
+    assert placed["w"].sharding.spec == P(("dp", "tp"), None)

@@ -279,3 +279,20 @@ def make_matmul():
     dense = FusedExecutor(FusedGraph.build(descriptor.node("model"), descriptor))
     out_dense = dense.on_event("mm/x", x, {})["mm/y"][0].to_numpy()
     np.testing.assert_allclose(out_sharded, out_dense, rtol=1e-5)
+
+
+def test_mesh_from_env_partial_spec(monkeypatch):
+    """'tp=4' alone must work: unspecified dp absorbs the remaining
+    devices instead of failing the axis-product check."""
+    import jax
+
+    from dora_tpu.tpu.fuse import mesh_from_env
+
+    if len(jax.devices()) != 8:
+        pytest.skip("needs the virtual 8-device CPU mesh")
+    monkeypatch.setenv("DORA_MESH", "tp=4")
+    assert dict(mesh_from_env().shape) == {"dp": 2, "tp": 4, "sp": 1}
+    monkeypatch.setenv("DORA_MESH", "dp=2,tp=2,sp=2")
+    assert dict(mesh_from_env().shape) == {"dp": 2, "tp": 2, "sp": 2}
+    monkeypatch.delenv("DORA_MESH")
+    assert mesh_from_env() is None
