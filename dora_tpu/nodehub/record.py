@@ -38,13 +38,22 @@ def main() -> None:
             otel = parse_otel_context(
                 str(event["metadata"].get("open_telemetry_context", ""))
             )
+            # Metadata rides along as JSON so a replay can re-attach it
+            # (tensor shape/dtype are load-bearing for consumers).
+            import json
+
+            metadata_json = json.dumps(
+                {k: v for k, v in event["metadata"].items()
+                 if isinstance(v, (str, int, float, bool, list))}
+            )
             batch = pa.record_batch(
                 [
                     pa.array([time.time_ns()], pa.int64()),
                     pa.array([otel.get("traceparent", "")]),
                     pa.array([pa.scalar(value.to_pylist())]),
+                    pa.array([metadata_json]),
                 ],
-                names=["timestamp_utc_ns", "trace", "value"],
+                names=["timestamp_utc_ns", "trace", "value", "metadata"],
             )
             writer = writers.get(input_id)
             if writer is None:

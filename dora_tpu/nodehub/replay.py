@@ -1,0 +1,98 @@
+"""Replay source: re-emit a recorded session from Parquet.
+
+The natural pair to ``nodehub/record.py`` (reference: dora-record writes
+Parquet; nothing upstream replays it): point ``RECORD_DIR`` at a
+recording and every ``<input>.parquet`` that matches one of this node's
+declared outputs becomes an output stream, re-emitted in original
+global order and paced by the recorded inter-arrival gaps — so a
+captured camera/model session drives a dataflow deterministically
+without the hardware that produced it. Recorded message metadata
+(tensor shape/dtype, trace context) is re-attached, and rows stream
+batch by batch (a multi-GB recording never materializes in memory).
+
+Env: ``RECORD_DIR`` (required), ``REPLAY_SPEED`` (1.0 = real time,
+2.0 = twice as fast, 0 = as fast as possible), ``REPLAY_LOOP``
+(repeat count, default 1).
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import os
+import time
+from pathlib import Path
+
+import pyarrow as pa
+
+from dora_tpu.node import Node
+
+
+def _stream_file(path: Path):
+    """Yield (timestamp_ns, output_id, value, metadata) row by row."""
+    import pyarrow.parquet as pq
+
+    output_id = path.stem
+    reader = pq.ParquetFile(path)
+    has_metadata = "metadata" in reader.schema_arrow.names
+    for batch in reader.iter_batches(batch_size=64):
+        stamps = batch.column("timestamp_utc_ns").to_pylist()
+        values = batch.column("value")
+        metas = (
+            batch.column("metadata").to_pylist()
+            if has_metadata
+            else [None] * len(stamps)
+        )
+        for i, ts in enumerate(stamps):
+            metadata = json.loads(metas[i]) if metas[i] else {}
+            yield ts, output_id, values[i].as_py(), metadata
+
+
+def stream_recording(record_dir: Path, outputs):
+    """Merged time-ordered event stream across the recorded files that
+    match this node's declared outputs (others are skipped with a note —
+    a graph that only consumes some streams must still replay)."""
+    files = sorted(record_dir.glob("*.parquet"))
+    if not files:
+        raise SystemExit(f"replay: no *.parquet recordings under {record_dir}")
+    selected = []
+    for path in files:
+        if path.stem in outputs:
+            selected.append(path)
+        else:
+            print(f"replay: skipping {path.name} (not a declared output)",
+                  flush=True)
+    if not selected:
+        raise SystemExit(
+            f"replay: none of {[f.name for f in files]} match declared "
+            f"outputs {sorted(outputs)}"
+        )
+    # key: order on timestamps only (values/metadata aren't comparable).
+    return heapq.merge(
+        *(_stream_file(p) for p in selected), key=lambda e: e[0]
+    )
+
+
+def main() -> None:
+    record_dir = Path(os.environ.get("RECORD_DIR", "record"))
+    speed = float(os.environ.get("REPLAY_SPEED", "1.0"))
+    loops = int(os.environ.get("REPLAY_LOOP", "1"))
+
+    sent = 0
+    with Node() as node:
+        declared = set(node.config.run_config.outputs)
+        for _ in range(loops):
+            prev_ts = None
+            for ts, output_id, value, metadata in stream_recording(
+                record_dir, declared
+            ):
+                if speed > 0 and prev_ts is not None and ts > prev_ts:
+                    time.sleep((ts - prev_ts) / 1e9 / speed)
+                prev_ts = ts
+                node.send_output(output_id, pa.array(value), metadata)
+                sent += 1
+    print(f"replayed {sent} events from {record_dir}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
