@@ -95,6 +95,9 @@ class Node:
     """One dataflow node (spawned by the daemon, or dynamic)."""
 
     def __init__(self, node_id: str | None = None, daemon_addr: str | None = None):
+        from dora_tpu.telemetry import install_stack_dump
+
+        install_stack_dump()
         config = self._load_config(node_id, daemon_addr)
         self._config = config
         self.dataflow_id = config.dataflow_id

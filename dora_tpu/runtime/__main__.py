@@ -1,15 +1,13 @@
 """Entry point for daemon-spawned runtime nodes
 (reference: dora_runtime::main, binaries/runtime/src/lib.rs:28-106)."""
 
-import faulthandler
-import signal
 import sys
 
 
 def main() -> None:
-    # Debuggability: `kill -USR1 <pid>` dumps all Python stacks to stderr
-    # (lands in the node's daemon-side log file).
-    faulthandler.register(signal.SIGUSR1)
+    from dora_tpu.telemetry import install_stack_dump
+
+    install_stack_dump()
     from dora_tpu.runtime import run
 
     sys.exit(run())

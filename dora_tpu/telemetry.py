@@ -21,6 +21,24 @@ OTEL_CTX_KEY = "open_telemetry_context"
 logger = logging.getLogger(__name__)
 
 
+def install_stack_dump() -> None:
+    """`kill -USR1 <pid>` dumps all Python stacks to stderr (the
+    daemon-side log file) — a wedged node in a stuck dataflow can always
+    be inspected post-hoc. Chains any pre-existing SIGUSR1 handler; opt
+    out with DORA_NO_STACK_DUMP=1 (e.g. when the host app owns the
+    signal entirely). Idempotent, process-level; called by Node() and
+    the runtime entry point."""
+    if os.environ.get("DORA_NO_STACK_DUMP"):
+        return
+    try:
+        import faulthandler
+        import signal
+
+        faulthandler.register(signal.SIGUSR1, chain=True)
+    except (ValueError, AttributeError, OSError):
+        pass  # no SIGUSR1 on this platform / not callable here
+
+
 # ---------------------------------------------------------------------------
 # context string codec (reference: serialize_context / deserialize_context)
 # ---------------------------------------------------------------------------
