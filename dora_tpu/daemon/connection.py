@@ -72,6 +72,8 @@ class ShmemConnection(NodeConnection):
     def __init__(self, channel: ShmemChannel):
         self.channel = channel
         self._closing = False
+        self._close_lock = threading.Lock()
+        self._channel_closed = False
         self._loop = asyncio.get_running_loop()
         self._incoming: asyncio.Queue[bytes | None] = asyncio.Queue()
         self._thread = threading.Thread(
@@ -122,14 +124,40 @@ class ShmemConnection(NodeConnection):
         except Exception:
             pass
 
-        def _finish(thread=self._thread, channel=self.channel):
+        def _finish(thread=self._thread):
             thread.join(timeout=5)
-            try:
-                channel.close()
-            except Exception:
-                pass
+            self._close_channel_once()
 
         threading.Thread(target=_finish, daemon=True).start()
+
+    def _close_channel_once(self) -> None:
+        """Free + unlink the native channel exactly once (the deferred
+        close() helper and the synchronous teardown path can both reach
+        here; a double native close would be a double munmap)."""
+        with self._close_lock:
+            if self._channel_closed:
+                return
+            self._channel_closed = True
+        try:
+            self.channel.close()
+        except Exception:
+            pass
+
+    def close_sync(self, timeout: float = 2.0) -> None:
+        """Close and unlink before returning — the daemon-teardown path.
+        The deferred close() is right for per-connection teardown during a
+        live run (never block the loop), but at process exit the helper
+        thread would be killed before shm_unlink runs, leaking segments.
+        Disconnect wakes the pump's blocked recv immediately, so the join
+        is bounded by one recv tick in practice. Safe after close():
+        whichever path reaches the native free first wins."""
+        self._closing = True
+        try:
+            self.channel.disconnect()
+        except Exception:
+            pass
+        self._thread.join(timeout=timeout)
+        self._close_channel_once()
 
 
 async def serve_stream(

@@ -194,6 +194,14 @@ class Daemon:
         for df in list(self.dataflows.values()):
             for t in df.timer_tasks:
                 t.cancel()
+            # Teardown reaper: node processes must never outlive the
+            # daemon (an aborted/timed-out dataflow otherwise leaks
+            # wedged nodes holding mapped shmem — observed as orphaned
+            # checker.py processes in round 2). The graceful path
+            # (stop_dataflow + grace kill) has already run by the time a
+            # healthy dataflow gets here, so these are stragglers: kill.
+            self._kill_stragglers(df)
+            self._close_shmem_conns(df)
             for region in df.mapped_regions.values():
                 try:
                     region.close(unlink=False, force=True)
@@ -710,9 +718,12 @@ class Daemon:
             except Exception:
                 pass
         df.mapped_regions.clear()
+        # Deferred close (never block the live loop); the conns stay in
+        # df.shmem_conns so Daemon.close() can still force the unlink
+        # synchronously before process exit (close_sync is close-once
+        # safe against this deferred path).
         for conn in df.shmem_conns:
             conn.close()
-        df.shmem_conns.clear()
         result = DataflowResult(
             uuid=df.id,
             node_results={
@@ -748,14 +759,27 @@ class Daemon:
 
     async def _grace_kill(self, df: DataflowState, grace_s: float) -> None:
         await asyncio.sleep(grace_s)
+        self._kill_stragglers(df, record_grace=True)
+
+    @staticmethod
+    def _kill_stragglers(df: DataflowState, record_grace: bool = False) -> None:
         for nid, running in df.running_nodes.items():
             if running.finished or running.process is None:
                 continue
-            df.grace_kills.add(nid)
+            if record_grace:
+                df.grace_kills.add(nid)
             try:
                 running.process.kill()
             except ProcessLookupError:
                 pass
+
+    @staticmethod
+    def _close_shmem_conns(df: DataflowState) -> None:
+        """Synchronous close + unlink (teardown path — must not outlive
+        the process; see ShmemConnection.close_sync)."""
+        for conn in df.shmem_conns:
+            conn.close_sync()
+        df.shmem_conns.clear()
 
     def reload_node(self, df: DataflowState, node_id: str, operator_id: str | None) -> None:
         queue = df.queues.get(node_id)
@@ -1014,6 +1038,10 @@ async def run_dataflow_async(
         working_dir = Path(working_dir or path.parent)
     descriptor.check(working_dir)
 
+    from dora_tpu.telemetry import install_task_dump, remove_task_dump
+
+    loop = asyncio.get_running_loop()
+    install_task_dump(loop)
     daemon = Daemon(local_comm=local_comm)
     await daemon.start()
     try:
@@ -1027,6 +1055,7 @@ async def run_dataflow_async(
         return await df.done
     finally:
         await daemon.close()
+        remove_task_dump(loop)
 
 
 def run_dataflow(

@@ -1,0 +1,405 @@
+"""Marian / Opus-MT translation models serving pretrained HF checkpoints.
+
+Faithful to transformers' ``MarianMTModel`` compute graph (post-layernorm
+encoder-decoder, sinusoidal position embeddings, embed scaling by
+sqrt(dim), SiLU ("swish") activation, final_logits_bias) so real Opus-MT
+checkpoint weights produce the same logits — asserted numerically in
+tests/test_hf_parity.py. The reference serves this family through torch
+(node-hub/dora-opus/dora_opus/main.py); here encode + greedy decode jit
+into XLA programs with a static-shape KV cache.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.hf.loader import (
+    linear,
+    maybe_bias,
+    read_config,
+    read_safetensors,
+)
+
+
+@dataclass(frozen=True)
+class MarianConfig:
+    vocab: int
+    dim: int
+    enc_layers: int
+    dec_layers: int
+    heads: int
+    ffn: int
+    max_positions: int
+    pad_token: int
+    eos_token: int
+    decoder_start_token: int
+    scale_embedding: bool
+    activation: str
+    forced_eos_token: int | None = None
+    max_tokens: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    @classmethod
+    def from_hf(cls, config: dict, max_tokens: int | None = None) -> "MarianConfig":
+        return cls(
+            vocab=config["vocab_size"],
+            dim=config["d_model"],
+            enc_layers=config["encoder_layers"],
+            dec_layers=config["decoder_layers"],
+            heads=config["encoder_attention_heads"],
+            ffn=config["encoder_ffn_dim"],
+            max_positions=config.get("max_position_embeddings", 512),
+            pad_token=config.get("pad_token_id", 0),
+            eos_token=config.get("eos_token_id", 0),
+            decoder_start_token=config.get(
+                "decoder_start_token_id", config.get("pad_token_id", 0)
+            ),
+            scale_embedding=config.get("scale_embedding", False),
+            activation=config.get("activation_function", "swish"),
+            forced_eos_token=config.get("forced_eos_token_id"),
+            max_tokens=max_tokens or 128,
+        )
+
+
+class MarianTokenizer:
+    """Tokenizer for Opus-MT checkpoints: sentencepiece segmentation
+    (``source.spm``/``target.spm``, parsed natively — see
+    dora_tpu.models.spm) + ``vocab.json`` piece→id mapping, ``</s>``
+    appended, ``<unk>`` for unmapped pieces. Matches transformers'
+    MarianTokenizer for the inference path."""
+
+    def __init__(self, model_dir: str | Path):
+        import json
+
+        from dora_tpu.models.spm import SentencePieceModel
+
+        model_dir = Path(model_dir)
+        self.vocab: dict[str, int] = json.loads(
+            (model_dir / "vocab.json").read_text()
+        )
+        self.ids: dict[int, str] = {v: k for k, v in self.vocab.items()}
+        self.source_spm = SentencePieceModel.load(model_dir / "source.spm")
+        target = model_dir / "target.spm"
+        self.target_spm = (
+            SentencePieceModel.load(target) if target.exists() else self.source_spm
+        )
+        self.unk_id = self.vocab.get("<unk>", 0)
+        self.eos_id = self.vocab.get("</s>", 0)
+        self.pad_id = self.vocab.get("<pad>", self.eos_id)
+
+    def encode(self, text: str) -> list[int]:
+        pieces = self.source_spm.encode(text)
+        return [self.vocab.get(p, self.unk_id) for p in pieces] + [self.eos_id]
+
+    def decode(self, ids) -> str:
+        from dora_tpu.models.spm import WORD_BOUNDARY
+
+        pieces = []
+        for i in ids:
+            i = int(i)
+            if i in (self.eos_id, self.pad_id):
+                continue
+            piece = self.ids.get(i)
+            if piece and not (piece.startswith("<") and piece.endswith(">")):
+                pieces.append(piece)
+        return "".join(pieces).replace(WORD_BOUNDARY, " ").strip()
+
+
+def sinusoidal_positions(n_positions: int, dim: int) -> np.ndarray:
+    """Marian's sinusoidal table: sin in the first dim/2 columns, cos in
+    the second half (transformers MarianSinusoidalPositionalEmbedding)."""
+    position = np.arange(n_positions, dtype=np.float32)[:, None]
+    div = np.exp(
+        np.arange(0, dim, 2, dtype=np.float32) * -(np.log(10000.0) / dim)
+    )
+    table = np.zeros((n_positions, dim), np.float32)
+    half = dim // 2
+    table[:, :half] = np.sin(position * div)
+    table[:, half:] = np.cos(position * div)
+    return table
+
+
+def load(model_dir: str | Path, max_tokens: int | None = None):
+    """(config, params) from a HF Marian checkpoint directory."""
+    hf_config = read_config(model_dir)
+    cfg = MarianConfig.from_hf(hf_config, max_tokens)
+    tensors = read_safetensors(model_dir)
+    params = map_params(tensors, cfg)
+    return cfg, params
+
+
+def _attn_params(tensors: dict, prefix: str) -> dict:
+    p = {
+        "wq": linear(tensors, prefix + "q_proj.weight"),
+        "wk": linear(tensors, prefix + "k_proj.weight"),
+        "wv": linear(tensors, prefix + "v_proj.weight"),
+        "wo": linear(tensors, prefix + "out_proj.weight"),
+    }
+    maybe_bias(p, "bq", tensors, prefix + "q_proj.bias")
+    maybe_bias(p, "bk", tensors, prefix + "k_proj.bias")
+    maybe_bias(p, "bv", tensors, prefix + "v_proj.bias")
+    maybe_bias(p, "bo", tensors, prefix + "out_proj.bias")
+    return p
+
+
+def map_params(tensors: dict, cfg: MarianConfig) -> dict:
+    """Checkpoint names → parameter pytree. Marian ties encoder/decoder
+    embeddings and the LM head to ``model.shared.weight``."""
+    prefix = "model." if any(k.startswith("model.") for k in tensors) else ""
+    shared = tensors.get(f"{prefix}shared.weight")
+    if shared is None:
+        shared = tensors[f"{prefix}encoder.embed_tokens.weight"]
+    params: dict[str, Any] = {
+        "embed": shared,
+        "final_logits_bias": tensors.get(
+            "final_logits_bias", np.zeros((cfg.vocab,), np.float32)
+        ).reshape(-1),
+        "enc_blocks": {},
+        "dec_blocks": {},
+    }
+    for i in range(cfg.enc_layers):
+        lp = f"{prefix}encoder.layers.{i}."
+        block = {
+            "attn": _attn_params(tensors, lp + "self_attn."),
+            "attn_ln_w": tensors[lp + "self_attn_layer_norm.weight"],
+            "attn_ln_b": tensors[lp + "self_attn_layer_norm.bias"],
+            "fc1": linear(tensors, lp + "fc1.weight"),
+            "fc1_b": tensors[lp + "fc1.bias"],
+            "fc2": linear(tensors, lp + "fc2.weight"),
+            "fc2_b": tensors[lp + "fc2.bias"],
+            "final_ln_w": tensors[lp + "final_layer_norm.weight"],
+            "final_ln_b": tensors[lp + "final_layer_norm.bias"],
+        }
+        params["enc_blocks"][str(i)] = block
+    for i in range(cfg.dec_layers):
+        lp = f"{prefix}decoder.layers.{i}."
+        block = {
+            "attn": _attn_params(tensors, lp + "self_attn."),
+            "attn_ln_w": tensors[lp + "self_attn_layer_norm.weight"],
+            "attn_ln_b": tensors[lp + "self_attn_layer_norm.bias"],
+            "xattn": _attn_params(tensors, lp + "encoder_attn."),
+            "xattn_ln_w": tensors[lp + "encoder_attn_layer_norm.weight"],
+            "xattn_ln_b": tensors[lp + "encoder_attn_layer_norm.bias"],
+            "fc1": linear(tensors, lp + "fc1.weight"),
+            "fc1_b": tensors[lp + "fc1.bias"],
+            "fc2": linear(tensors, lp + "fc2.weight"),
+            "fc2_b": tensors[lp + "fc2.bias"],
+            "final_ln_w": tensors[lp + "final_layer_norm.weight"],
+            "final_ln_b": tensors[lp + "final_layer_norm.bias"],
+        }
+        params["dec_blocks"][str(i)] = block
+    params["positions"] = sinusoidal_positions(cfg.max_positions, cfg.dim)
+    return jax.tree.map(jnp.asarray, params)
+
+
+def _ln(x, w, b, eps=1e-5):
+    mean = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+    y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
+    return (y * w + b).astype(x.dtype)
+
+
+def _proj(x, p, wk, bk, dtype):
+    y = x @ p[wk].astype(dtype)
+    if bk in p:
+        y = y + p[bk].astype(dtype)
+    return y
+
+
+def _mha(p, q_in, kv, heads: int, mask=None, cache=None, cache_index=None):
+    """Marian attention: scaling 1/sqrt(head_dim) applied to q."""
+    dtype = q_in.dtype
+    b, tq, dim = q_in.shape
+    head_dim = dim // heads
+    q = _proj(q_in, p, "wq", "bq", dtype).reshape(b, tq, heads, head_dim)
+    q = q.transpose(0, 2, 1, 3)
+    if isinstance(kv, tuple):  # precomputed cross-attention k/v
+        k, v = kv
+    else:
+        tk = kv.shape[1]
+        k = _proj(kv, p, "wk", "bk", dtype).reshape(b, tk, heads, head_dim)
+        v = _proj(kv, p, "wv", "bv", dtype).reshape(b, tk, heads, head_dim)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+    if cache is not None:
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, 0, cache_index, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, 0, cache_index, 0)
+        )
+    out = L.attention(q, k.astype(dtype), v.astype(dtype), mask)
+    out = out.transpose(0, 2, 1, 3).reshape(b, tq, dim)
+    out = _proj(out, p, "wo", "bo", dtype)
+    new_cache = {"k": k, "v": v} if cache is not None else None
+    return out, new_cache
+
+
+_ACTIVATIONS = {
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "relu": jax.nn.relu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+}
+
+
+def _ffn(block, x, dtype, activation: str):
+    h = x @ block["fc1"].astype(dtype) + block["fc1_b"].astype(dtype)
+    h = _ACTIVATIONS[activation](h)
+    return h @ block["fc2"].astype(dtype) + block["fc2_b"].astype(dtype)
+
+
+def _embed_scale(cfg: MarianConfig) -> float:
+    return float(np.sqrt(cfg.dim)) if cfg.scale_embedding else 1.0
+
+
+def encode(params, cfg: MarianConfig, src_ids, src_mask=None):
+    """src_ids [B, S] → encoder states [B, S, dim].
+
+    ``src_mask`` [B, S] bool marks real (non-pad) tokens; defaults to
+    everything-real. Post-layernorm blocks, exactly transformers'
+    MarianEncoderLayer ordering.
+    """
+    dtype = L.compute_dtype()
+    b, s = src_ids.shape
+    x = params["embed"].astype(dtype)[src_ids] * _embed_scale(cfg)
+    x = x + params["positions"][:s].astype(dtype)[None]
+    attn_mask = None
+    if src_mask is not None:
+        attn_mask = src_mask[:, None, None, :]
+    for i in range(cfg.enc_layers):
+        block = params["enc_blocks"][str(i)]
+        h, _ = _mha(block["attn"], x, x, cfg.heads, mask=attn_mask)
+        x = _ln(x + h, block["attn_ln_w"], block["attn_ln_b"])
+        h = _ffn(block, x, dtype, cfg.activation)
+        x = _ln(x + h, block["final_ln_w"], block["final_ln_b"])
+    return x
+
+
+def _decoder(params, cfg: MarianConfig, tok_embed, positions_slice, enc_kv,
+             self_mask, caches, cache_index, cross_mask=None):
+    dtype = tok_embed.dtype
+    x = tok_embed + positions_slice
+    new_caches = {}
+    for i in range(cfg.dec_layers):
+        block = params["dec_blocks"][str(i)]
+        h, c = _mha(
+            block["attn"], x, x, cfg.heads, mask=self_mask,
+            cache=None if caches is None else caches[str(i)],
+            cache_index=cache_index,
+        )
+        if c is not None:
+            new_caches[str(i)] = c
+        x = _ln(x + h, block["attn_ln_w"], block["attn_ln_b"])
+        h, _ = _mha(block["xattn"], x, enc_kv[str(i)], cfg.heads,
+                    mask=cross_mask)
+        x = _ln(x + h, block["xattn_ln_w"], block["xattn_ln_b"])
+        h = _ffn(block, x, dtype, cfg.activation)
+        x = _ln(x + h, block["final_ln_w"], block["final_ln_b"])
+    return x, new_caches
+
+
+def _enc_kv(params, cfg: MarianConfig, enc):
+    dtype = enc.dtype
+    b, s, _ = enc.shape
+    kv = {}
+    for i in range(cfg.dec_layers):
+        p = params["dec_blocks"][str(i)]["xattn"]
+        k = _proj(enc, p, "wk", "bk", dtype).reshape(
+            b, s, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(enc, p, "wv", "bv", dtype).reshape(
+            b, s, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        kv[str(i)] = (k, v)
+    return kv
+
+
+@partial(jax.jit, static_argnums=(1,))
+def forward(params, cfg: MarianConfig, src_ids, dec_ids):
+    """Teacher-forced logits [B, T, vocab] float32 (parity surface)."""
+    dtype = L.compute_dtype()
+    enc = encode(params, cfg, src_ids)
+    b, t = dec_ids.shape
+    tok = params["embed"].astype(dtype)[dec_ids] * _embed_scale(cfg)
+    pos = params["positions"][:t].astype(dtype)[None]
+    mask = L.causal_mask(t, t)
+    x, _ = _decoder(
+        params, cfg, tok, pos, _enc_kv(params, cfg, enc), mask, None, None
+    )
+    logits = x @ params["embed"].astype(dtype).T
+    return (logits + params["final_logits_bias"]).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=(1, 3))
+def translate(params, cfg: MarianConfig, src_ids, max_new_tokens: int,
+              src_mask=None):
+    """Greedy decode [B, S] → [B, max_new_tokens] int32, one XLA program.
+
+    ``src_mask`` [B, S] bool marks real source tokens (padding is masked
+    out of encoder self-attention and decoder cross-attention). Starts
+    from ``decoder_start_token``; output includes everything after it
+    (the caller strips at ``eos_token``).
+    """
+    if max_new_tokens > cfg.max_tokens:
+        # Cache writes past max_tokens would be silently clamped by XLA,
+        # overwriting the last slot — fail loudly at trace time instead.
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds the KV-cache "
+            f"capacity ({cfg.max_tokens}); reload with a larger max_tokens"
+        )
+    dtype = L.compute_dtype()
+    enc = encode(params, cfg, src_ids, src_mask=src_mask)
+    b = src_ids.shape[0]
+    cross_mask = None if src_mask is None else src_mask[:, None, None, :]
+    enc_kv = _enc_kv(params, cfg, enc)
+    scale = _embed_scale(cfg)
+    caches = {
+        str(i): {
+            "k": jnp.zeros((b, cfg.heads, cfg.max_tokens, cfg.head_dim), dtype),
+            "v": jnp.zeros((b, cfg.heads, cfg.max_tokens, cfg.head_dim), dtype),
+        }
+        for i in range(cfg.dec_layers)
+    }
+    embed = params["embed"].astype(dtype)
+
+    def step(carry, _):
+        token, caches, pos = carry
+        tok = embed[token][:, None, :] * scale
+        pos_slice = jax.lax.dynamic_slice_in_dim(
+            params["positions"].astype(dtype), pos, 1
+        )[None]
+        mask = (jnp.arange(cfg.max_tokens) <= pos)[None, None, None, :]
+        x, caches = _decoder(
+            params, cfg, tok, pos_slice, enc_kv, mask, caches, pos,
+            cross_mask=cross_mask,
+        )
+        logits = (x[:, -1] @ embed.T + params["final_logits_bias"]).astype(
+            jnp.float32
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if cfg.forced_eos_token is not None:
+            # transformers: forced_eos_token_id replaces the final token
+            # when max length is reached (Marian configs set it to </s>).
+            nxt = jnp.where(
+                pos == max_new_tokens - 1,
+                jnp.int32(cfg.forced_eos_token),
+                nxt,
+            )
+        return (nxt, caches, pos + 1), nxt
+
+    start = jnp.full((b,), cfg.decoder_start_token, jnp.int32)
+    _, tokens = jax.lax.scan(
+        step, (start, caches, jnp.asarray(0, jnp.int32)), None,
+        length=max_new_tokens,
+    )
+    return tokens.T

@@ -112,11 +112,17 @@ def attention(q, k, v, mask=None):
 
 
 def use_flash() -> bool:
-    """Pallas block-streamed attention for the no-cache self-attention
-    paths (DORA_FLASH_ATTENTION=1; see dora_tpu.ops.flash_attention)."""
+    """Flash attention for the no-cache self-attention paths (see
+    dora_tpu.ops.flash_attention). Default ON on TPU (the kernel's VMEM
+    use is flat in T, so it is safe at any length); elsewhere the Pallas
+    interpreter would be slower than dense, so default OFF. Override
+    either way with DORA_FLASH_ATTENTION=1/0."""
     import os
 
-    return os.environ.get("DORA_FLASH_ATTENTION", "") not in ("", "0")
+    v = os.environ.get("DORA_FLASH_ATTENTION")
+    if v is not None:
+        return v not in ("", "0")
+    return jax.default_backend() == "tpu"
 
 
 def causal_mask(tq: int, tk: int, offset: int = 0):

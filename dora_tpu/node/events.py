@@ -27,6 +27,9 @@ from dora_tpu.message.common import (
 )
 from dora_tpu.native import ShmemRegion
 
+#: pump-internal marker: the daemon closed the stream (AllInputsClosed).
+_END = object()
+
 
 class Event:
     """One dataflow event. Dict-like for dora API compatibility."""
@@ -94,6 +97,9 @@ class EventStream:
         self._pending_acks: list[str] = []
         self._acks_lock = threading.Lock()
         self._closed = threading.Event()
+        #: set by the pump once no further real events can arrive (the
+        #: end-of-stream sentinel is queued or being queued)
+        self._eos = threading.Event()
         #: shmem_id -> mapped region (kept mapped for the stream's lifetime;
         #: senders never reuse a region name after unlinking, so a cached
         #: mapping can never go stale)
@@ -107,8 +113,16 @@ class EventStream:
 
     @property
     def ended(self) -> bool:
-        """True once the stream closed (all inputs closed / daemon gone)."""
-        return self._closed.is_set() and self._queue.empty()
+        """True once the stream closed (all inputs closed / daemon gone)
+        and no real events remain to consume. Works for poll-only users
+        that never call recv(): the queued end-of-stream sentinel does
+        not count as a remaining event."""
+        if self._closed.is_set() and self._queue.empty():
+            return True
+        if not self._eos.is_set():
+            return False
+        with self._queue.mutex:
+            return all(item is None for item in self._queue.queue)
 
     def recv(self, timeout: float | None = None) -> Event | None:
         """Next event, or None when the stream ended (or timeout expired)."""
@@ -172,14 +186,28 @@ class EventStream:
                 reply = self._channel.request(n2d.NextEvent(drop_tokens=acks))
                 if not isinstance(reply, d2n.NextEvents) or not reply.events:
                     break
+                ended = False
                 for ts in reply.events:
                     event = self._convert(ts.inner)
+                    if event is _END:
+                        # End of stream: do NOT set _closed here — only the
+                        # queued None sentinel may end the stream. Setting
+                        # the flag from this thread disarmed the sentinel
+                        # put below while the consumer was already parked
+                        # inside queue.get(), deadlocking it (the round-2
+                        # shmem "reply loss": the reply arrived fine; this
+                        # handoff lost it).
+                        ended = True
+                        break
                     if event is not None and not self._put(event):
                         return
+                if ended:
+                    break
         except Exception as e:
             if not self._closed.is_set():
                 self._put(Event(type="ERROR", error=str(e)))
         finally:
+            self._eos.set()  # no further real events after this point
             # The end-of-stream sentinel must land (recv blocks without
             # it); retry around a full buffer unless the consumer closed.
             while not self._closed.is_set():
@@ -208,8 +236,7 @@ class EventStream:
         if isinstance(inner, d2n.InputClosed):
             return Event(type="INPUT_CLOSED", id=inner.id)
         if isinstance(inner, d2n.AllInputsClosed):
-            self._closed.set()
-            return None
+            return _END
         if isinstance(inner, d2n.Stop):
             return Event(type="STOP")
         if isinstance(inner, d2n.Reload):

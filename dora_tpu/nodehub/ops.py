@@ -334,11 +334,29 @@ def make_tts() -> JaxOperator:
 
 
 def make_vad() -> JaxOperator:
-    """Audio chunk [samples] -> speech probability; GRU state threads
-    across ticks in device memory."""
+    """Audio chunk [samples] -> speech probability.
+
+    With DORA_HF_CHECKPOINT pointing at a Wav2Vec2 audio-frame
+    classification directory (superb/sd-class), serves the real
+    pretrained model: per-chunk speech probability = max frame speech
+    probability (reference job: dora-vad's Silero gate). Otherwise the
+    self-contained GRU whose state threads across ticks in device
+    memory."""
     import jax.numpy as jnp
 
     from dora_tpu.models import vad
+
+    hf_path = _hf_checkpoint("wav2vec2")
+    if hf_path:
+        from dora_tpu.models.hf import wav2vec2
+
+        cfg, params = wav2vec2.load(hf_path)
+
+        def hf_step(state, inputs):
+            probs = wav2vec2.speech_probability(state, cfg, inputs["audio"][None])
+            return state, {"prob": jnp.max(probs, axis=-1)}
+
+        return JaxOperator(step=hf_step, init_state=params)
 
     cfg = vad.VADConfig.tiny() if _size() == "tiny" else vad.VADConfig()
     params = _maybe_restore(vad.init_params(jax.random.PRNGKey(0), cfg), "vad")

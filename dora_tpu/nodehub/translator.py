@@ -1,0 +1,94 @@
+"""Pretrained translation node (Opus-MT / Marian).
+
+Reference parity: node-hub/dora-opus/dora_opus/main.py — text in,
+translated text out through a pretrained Marian checkpoint. Here the
+model is the JAX Marian implementation (dora_tpu.models.hf.marian,
+torch-parity-tested) and tokenization is the native sentencepiece
+unigram segmenter (dora_tpu.models.spm) — host-side tokenize, jitted
+encode+greedy-decode on device, host-side detokenize.
+
+Env:
+- ``DORA_HF_CHECKPOINT``: Marian safetensors directory (config.json,
+  vocab.json, source.spm[, target.spm]). Required — this node exists to
+  serve real weights; the trainable self-contained path stays on the
+  ``make_translator`` jax operator.
+- ``DORA_MAX_NEW_TOKENS`` (default 64), ``DORA_MAX_SRC`` (default 64).
+
+Input events: ``text`` — an Arrow string array (each element translated
+in order) or utf-8 bytes. Output: ``text`` — Arrow string array.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+
+from dora_tpu.node import Node
+
+
+def _texts_from_event(value) -> list[str]:
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return [bytes(value).decode("utf-8", errors="replace")]
+    if isinstance(value, pa.ChunkedArray):
+        value = value.combine_chunks()
+    if isinstance(value, pa.Array):
+        if pa.types.is_string(value.type) or pa.types.is_large_string(value.type):
+            return [str(v) for v in value.to_pylist() if v is not None]
+        # numeric array: utf-8 bytes / token ids from a byte-codec stage
+        data = np.asarray(value.to_numpy(zero_copy_only=False))
+        return [bytes(int(b) & 0xFF for b in data.reshape(-1)).decode(
+            "utf-8", errors="replace")]
+    return [str(value)]
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from dora_tpu.models.hf import marian
+
+    checkpoint = os.environ.get("DORA_HF_CHECKPOINT")
+    if not checkpoint:
+        raise RuntimeError(
+            "dora_tpu.nodehub.translator serves a pretrained Marian "
+            "checkpoint; set DORA_HF_CHECKPOINT (for the self-contained "
+            "trainable path use the make_translator jax operator)"
+        )
+    max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "64"))
+    max_src = int(os.environ.get("DORA_MAX_SRC", "64"))
+    cfg, params = marian.load(checkpoint, max_tokens=max_new)
+    tok = marian.MarianTokenizer(checkpoint)
+
+    def translate_one(text: str) -> str:
+        ids = tok.encode(text)
+        if len(ids) > max_src:  # truncate pieces but keep the closing </s>
+            ids = ids[: max_src - 1] + [tok.eos_id]
+        src = np.full((1, max_src), cfg.pad_token, np.int32)
+        src[0, : len(ids)] = ids
+        mask = jnp.asarray(np.arange(max_src)[None, :] < len(ids))
+        out = np.asarray(
+            marian.translate(params, cfg, jnp.asarray(src), max_new,
+                             src_mask=mask)
+        )[0]
+        keep = []
+        for t in out:
+            if int(t) == cfg.eos_token:
+                break
+            keep.append(int(t))
+        return tok.decode(keep)
+
+    with Node() as node:
+        for event in node:
+            if event["type"] == "STOP":
+                break
+            if event["type"] != "INPUT":
+                continue
+            texts = _texts_from_event(event["value"])
+            node.send_output(
+                "text", pa.array([translate_one(t) for t in texts])
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,46 @@ def install_stack_dump() -> None:
         pass  # no SIGUSR1 on this platform / not callable here
 
 
+def install_task_dump(loop) -> None:
+    """`kill -USR2 <pid>` dumps every asyncio task's await stack to
+    stderr — the counterpart of :func:`install_stack_dump` for coroutines
+    (which faulthandler cannot see: a parked coroutine is not on any
+    thread's stack). Used by the standalone daemon; forensics for wedged
+    dataflows."""
+    if os.environ.get("DORA_NO_STACK_DUMP"):
+        return
+    import signal
+    import sys
+    import traceback
+
+    def _dump() -> None:
+        import asyncio
+
+        print(f"--- asyncio task dump ({len(asyncio.all_tasks(loop))} tasks)",
+              file=sys.stderr)
+        for task in asyncio.all_tasks(loop):
+            print(f"task {task.get_name()}: {task}", file=sys.stderr)
+            for frame in task.get_stack():
+                traceback.print_stack(frame, limit=1, file=sys.stderr)
+        sys.stderr.flush()
+
+    try:
+        loop.add_signal_handler(signal.SIGUSR2, _dump)
+    except (ValueError, NotImplementedError, OSError, RuntimeError):
+        pass
+
+
+def remove_task_dump(loop) -> None:
+    """Unbind the SIGUSR2 handler (the loop is about to close; a later
+    signal must not hit a dead loop's wakeup fd)."""
+    import signal
+
+    try:
+        loop.remove_signal_handler(signal.SIGUSR2)
+    except (ValueError, NotImplementedError, OSError, RuntimeError):
+        pass
+
+
 # ---------------------------------------------------------------------------
 # context string codec (reference: serialize_context / deserialize_context)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,71 @@
+"""Dump the header of a native shmem channel (forensics for wedged runs).
+
+Reads the ChannelHeader atomics (native/shmem.cpp) straight out of
+/dev/shm without touching the protocol — safe on a live or wedged
+channel. Usage::
+
+    python -m dora_tpu.tools.chandump            # every dtp-* channel
+    python -m dora_tpu.tools.chandump NAME...    # specific regions
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+from pathlib import Path
+
+MAGIC = 0xD02A79C2
+
+# offsetof() per g++ on this platform (see native/shmem.cpp ChannelHeader)
+_FIELDS = [
+    ("magic", 0, "I"),
+    ("capacity", 4, "I"),
+    ("server_event", 8, "I"),
+    ("client_event", 12, "I"),
+    ("c2s_free", 16, "I"),
+    ("s2c_free", 20, "I"),
+    ("c2s_pending", 24, "I"),
+    ("s2c_pending", 28, "I"),
+    ("disconnected", 32, "I"),
+    ("len", 40, "Q"),
+]
+
+
+def dump_channel(path: Path) -> dict:
+    raw = path.read_bytes()[:48]
+    out = {}
+    for name, off, fmt in _FIELDS:
+        (out[name],) = struct.unpack_from("<" + fmt, raw, off)
+    out["is_channel"] = out["magic"] == MAGIC
+    return out
+
+
+def format_channel(name: str, h: dict) -> str:
+    if not h["is_channel"]:
+        return f"{name}: not a channel (raw region)"
+    return (
+        f"{name}: cap={h['capacity']} len={h['len']} "
+        f"srv_ev={h['server_event']} cli_ev={h['client_event']} "
+        f"c2s_pend={h['c2s_pending']} s2c_pend={h['s2c_pending']} "
+        f"c2s_free={h['c2s_free']} s2c_free={h['s2c_free']} "
+        f"disc={h['disconnected']}"
+    )
+
+
+def main(argv: list[str]) -> int:
+    shm = Path("/dev/shm")
+    paths = (
+        [shm / a for a in argv]
+        if argv
+        else sorted(p for p in shm.glob("dtp-*") if p.is_file())
+    )
+    for p in paths:
+        try:
+            print(format_channel(p.name, dump_channel(p)))
+        except OSError as e:
+            print(f"{p.name}: unreadable ({e})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,301 @@
+"""Deliberate-starvation stress harness for the shmem transport.
+
+Reproduces the round-2 flake (`test_c_node_large_payload_shmem` timeout
+under machine load): runs the python->C->python large-payload dataflow
+repeatedly while CPU burners saturate the scheduler. On a hang it
+captures forensics before killing anything: channel-header dumps
+(chandump), SIGUSR1 python stack dumps, daemon-side logs.
+
+Usage::
+
+    python -m dora_tpu.tools.stress_shmem [--iters 20] [--burners 6]
+        [--timeout 60]
+
+Exit status 0 = all iterations completed; 1 = a hang was caught (the
+forensics are printed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+RUNNER = """
+import asyncio, faulthandler, gc, signal, sys, traceback
+faulthandler.register(signal.SIGUSR1, chain=True)
+from dora_tpu.daemon.core import Daemon, run_dataflow_async
+
+
+def await_chain(task):
+    out = []
+    coro = task.get_coro()
+    while coro is not None:
+        frame = getattr(coro, "cr_frame", None) or getattr(coro, "gi_frame", None)
+        if frame is not None:
+            out.append(f"{frame.f_code.co_filename}:{frame.f_lineno} "
+                       f"{frame.f_code.co_name}")
+        nxt = getattr(coro, "cr_await", None) or getattr(coro, "gi_yieldfrom", None)
+        if nxt is coro or nxt is None:
+            if nxt is not None:
+                out.append(f"awaiting {nxt!r}")
+            break
+        coro = nxt
+    return out
+
+
+def dump_state() -> None:
+    import os, signal
+    print("=== in-process hang dump ===", file=sys.stderr)
+    for task in asyncio.all_tasks():
+        print(f"task {task.get_name()}: {task}", file=sys.stderr)
+        for line in await_chain(task):
+            print(f"    {line}", file=sys.stderr)
+    for obj in gc.get_objects():
+        if isinstance(obj, Daemon):
+            for df in obj.dataflows.values():
+                for nid, running in df.running_nodes.items():
+                    if running.process is not None and not running.finished:
+                        try:
+                            os.kill(running.process.pid, signal.SIGUSR1)
+                            print(f"  SIGUSR1 -> {nid} pid={running.process.pid}",
+                                  file=sys.stderr)
+                        except ProcessLookupError:
+                            pass
+                print(f"dataflow {df.id}:", file=sys.stderr)
+                for nid, q in df.queues.items():
+                    print(
+                        f"  queue {nid}: entries={len(q.entries)} "
+                        f"closed={q.closed} waiter={q.waiter}",
+                        file=sys.stderr,
+                    )
+                for nid, dq in df.drop_queues.items():
+                    print(
+                        f"  dropq {nid}: tokens={len(dq.tokens)} "
+                        f"closed={dq.closed} waiter={dq.waiter}",
+                        file=sys.stderr,
+                    )
+                print(f"  open_outputs={sorted(map(str, df.open_outputs))}",
+                      file=sys.stderr)
+                print(f"  open_inputs={df.open_inputs}", file=sys.stderr)
+                print(f"  tokens={df.tokens}", file=sys.stderr)
+                print(f"  running="
+                      f"{ {n: r.finished for n, r in df.running_nodes.items()} }",
+                      file=sys.stderr)
+                for conn in df.shmem_conns:
+                    print(
+                        f"  conn {conn.channel.name}: closing={conn._closing} "
+                        f"incoming={conn._incoming.qsize()}",
+                        file=sys.stderr,
+                    )
+    faulthandler.dump_traceback(file=sys.stderr)
+    sys.stderr.flush()
+
+
+async def main() -> int:
+    work = asyncio.ensure_future(
+        run_dataflow_async(sys.argv[1], local_comm="shmem")
+    )
+    try:
+        result = await asyncio.wait_for(asyncio.shield(work), float(sys.argv[2]))
+    except asyncio.TimeoutError:
+        dump_state()
+        # Give the wedged nodes' SIGUSR1 stack dumps time to drain through
+        # the daemon's stderr pumps into the log files before teardown.
+        await asyncio.sleep(3)
+        return 3
+    if not result.is_ok():
+        print("FAILED:", result.errors(), flush=True)
+        return 2
+    print("ITERATION-OK", flush=True)
+    return 0
+
+
+sys.exit(asyncio.run(main()))
+"""
+
+CHECKER = """
+from dora_tpu.node import Node
+
+node = Node()
+seen = 0
+for event in node:
+    if event["type"] != "INPUT":
+        continue
+    data = bytes(event["value"])
+    assert len(data) == 100_000, len(data)
+    assert data == bytes(range(256)) * 390 + bytes(160), "corrupt"
+    seen += 1
+node.close()
+assert seen == 3, seen
+print("large payloads ok")
+"""
+
+SENDER = """
+from dora_tpu.node import Node
+
+payload = bytes(range(256)) * 390 + bytes(160)
+with Node() as node:
+    for _ in range(3):
+        node.send_output("data", payload)
+"""
+
+
+def compile_relay(tmp: Path) -> Path:
+    from tests.test_c_node_api import C_RELAY  # reuse the exact test node
+
+    src = tmp / "relay.c"
+    src.write_text(textwrap.dedent(C_RELAY))
+    out = tmp / "relay"
+    native = REPO / "native"
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-I", str(native), str(src),
+         str(native / "node_api.cpp"), str(native / "shmem.cpp"),
+         "-o", str(out), "-lrt", "-pthread"],
+        check=True,
+    )
+    return out
+
+
+def collect_forensics(
+    child: subprocess.Popen, stderr_path: Path, burners: list
+) -> None:
+    print("=" * 70)
+    print("HANG DETECTED — forensics before teardown")
+    print("=" * 70, flush=True)
+    subprocess.run([sys.executable, "-m", "dora_tpu.tools.chandump"])
+    # Un-starve the machine first: if the hang self-heals without load it
+    # is a livelock, not a deadlock — report which.
+    for b in burners:
+        b.kill()
+    try:
+        child.wait(timeout=10)
+        print("SELF-HEALED after removing load: livelock, not deadlock")
+        return
+    except subprocess.TimeoutExpired:
+        print("still hung 10s after load removed: genuine deadlock")
+    # SIGUSR1 the whole process group: every python process dumps thread
+    # stacks to its stderr (nodes: daemon-side log files; runner: its
+    # stderr file). SIGUSR2 to the runner: asyncio task dump.
+    try:
+        os.killpg(child.pid, signal.SIGUSR1)
+        os.kill(child.pid, signal.SIGUSR2)
+    except ProcessLookupError:
+        pass
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            if "task dump" in stderr_path.read_text():
+                break
+        except OSError:
+            pass
+        time.sleep(1)
+    time.sleep(2)  # let node-side dumps drain into daemon log files
+    print("--- runner stderr (thread + task dumps) ---")
+    try:
+        print(stderr_path.read_text())
+    except OSError as e:
+        print(f"unreadable: {e}")
+    print("--- channel state after dumps ---")
+    subprocess.run([sys.executable, "-m", "dora_tpu.tools.chandump"])
+    try:
+        ps = subprocess.run(
+            ["ps", "-eo", "pid,ppid,stat,etime,args"], capture_output=True,
+            text=True)
+        lines = [l for l in ps.stdout.splitlines()
+                 if "checker" in l or "relay" in l or "runner" in l]
+        print("\n".join(lines))
+    except Exception:
+        pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--burners", type=int, default=6)
+    ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--keep-logs", action="store_true")
+    args = ap.parse_args()
+
+    tmp = Path(tempfile.mkdtemp(prefix="dtp-stress-"))
+    relay = compile_relay(tmp)
+    (tmp / "checker.py").write_text(textwrap.dedent(CHECKER))
+    (tmp / "big_sender.py").write_text(textwrap.dedent(SENDER))
+    import yaml
+
+    df = tmp / "dataflow.yml"
+    df.write_text(yaml.safe_dump({
+        "nodes": [
+            {"id": "sender", "path": "big_sender.py", "outputs": ["data"]},
+            {"id": "relay", "path": str(relay),
+             "inputs": {"in": "sender/data"}, "outputs": ["echo"]},
+            {"id": "checker", "path": "checker.py",
+             "inputs": {"in": "relay/echo"}},
+        ],
+        "communication": {"local": "shmem"},
+    }))
+    runner = tmp / "runner.py"
+    runner.write_text(textwrap.dedent(RUNNER))
+
+    burners = [
+        subprocess.Popen([sys.executable, "-c", "while True: pass"])
+        for _ in range(args.burners)
+    ]
+    print(f"{args.burners} burners up; {args.iters} iterations, "
+          f"{args.timeout}s timeout each", flush=True)
+    failed = 0
+    try:
+        for i in range(args.iters):
+            t0 = time.monotonic()
+            env = dict(os.environ)
+            env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+            stderr_path = tmp / f"runner-{i}.stderr"
+            with open(stderr_path, "wb") as stderr_file:
+                child = subprocess.Popen(
+                    [sys.executable, str(runner), str(df), str(args.timeout)],
+                    cwd=tmp, start_new_session=True, env=env,
+                    stderr=stderr_file,
+                )
+                try:
+                    rc = child.wait(timeout=args.timeout + 60)
+                except subprocess.TimeoutExpired:
+                    collect_forensics(child, stderr_path, burners)
+                    failed = 1
+                    os.killpg(child.pid, signal.SIGKILL)
+                    child.wait()
+                    print(f"iter {i}: HANG (forensics above; logs under {tmp})")
+                    break
+            dt = time.monotonic() - t0
+            print(f"iter {i}: rc={rc} {dt:.1f}s", flush=True)
+            if rc == 3:
+                failed = 1
+                print(f"iter {i}: HANG (in-process dump in {stderr_path})")
+                print(stderr_path.read_text())
+                subprocess.run([sys.executable, "-m", "dora_tpu.tools.chandump"])
+                try:
+                    os.killpg(child.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                break
+            if rc != 0:
+                failed = 1
+                break
+    finally:
+        for b in burners:
+            b.kill()
+        leftovers = sorted(Path("/dev/shm").glob("dtp-*"))
+        if leftovers and failed:
+            print(f"leaked shm: {[p.name for p in leftovers]}")
+    return failed
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""EventStream unit tests around the end-of-stream handoff.
+
+Regression for the round-2/round-3 "shmem reply loss" deadlock: the pump
+thread used to set ``_closed`` directly when converting AllInputsClosed,
+which disarmed the finally-block's None sentinel — a consumer already
+parked inside ``queue.get(timeout=None)`` (it passed the closed+empty
+fast-path check just before the flag flipped) then blocked forever. The
+stream must end ONLY via the queued sentinel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from dora_tpu.clock import HLC
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message import node_to_daemon as n2d
+from dora_tpu.message.common import InlineData, Metadata, TypeInfo
+from dora_tpu.message.serde import Timestamped
+from dora_tpu.node.events import EventStream
+
+
+class FakeChannel:
+    """Scripted events channel: each NextEvent request pops one reply."""
+
+    def __init__(self, batches):
+        self._batches = list(batches)
+        self._clock = HLC()
+        self.release = threading.Event()
+        self.release.set()
+        self.requests = 0
+
+    def _wrap(self, inner):
+        return Timestamped(inner=inner, timestamp=self._clock.new_timestamp())
+
+    def request(self, msg):
+        assert isinstance(msg, n2d.NextEvent)
+        self.requests += 1
+        self.release.wait()
+        if not self._batches:
+            return d2n.NextEvents(events=[])
+        return d2n.NextEvents(events=[self._wrap(e) for e in self._batches.pop(0)])
+
+    def interrupt(self):
+        self.release.set()
+
+    def close(self):
+        pass
+
+
+def _input(i: int):
+    return d2n.Input(
+        id="in",
+        metadata=Metadata(type_info=TypeInfo(encoding="raw", len=1), parameters={}),
+        data=InlineData(data=bytes([i])),
+    )
+
+
+def test_all_inputs_closed_wakes_parked_consumer():
+    """Consumer parked in recv() BEFORE the final [AllInputsClosed]-only
+    batch arrives must still wake with None (pre-fix: deadlock)."""
+    channel = FakeChannel([[_input(1)], [d2n.AllInputsClosed()]])
+    channel.release.clear()
+    stream = EventStream(channel)
+    got = []
+    done = threading.Event()
+
+    def consume():
+        while True:
+            event = stream.recv()
+            if event is None:
+                break
+            got.append(event)
+        done.set()
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    time.sleep(0.3)  # consumer parks inside queue.get before any batch
+    channel.release.set()
+    assert done.wait(timeout=10), "consumer deadlocked waiting for sentinel"
+    assert [e.type for e in got] == ["INPUT"]
+    stream.close()
+
+
+def test_input_closed_then_end():
+    channel = FakeChannel(
+        [[_input(1), d2n.InputClosed(id="in"), d2n.AllInputsClosed()]]
+    )
+    stream = EventStream(channel)
+    kinds = [e.type for e in iter(stream)]
+    assert kinds == ["INPUT", "INPUT_CLOSED"]
+    assert stream.recv(timeout=0.1) is None
+    stream.close()
+
+
+def test_empty_reply_ends_stream():
+    channel = FakeChannel([[_input(7)]])
+    stream = EventStream(channel)
+    first = stream.recv()
+    assert first.type == "INPUT"
+    assert stream.recv() is None
+    stream.close()
+
+
+@pytest.mark.parametrize("n", [25])
+def test_parked_consumer_stress(n):
+    """The exact race, many times: consumer always parks first."""
+    for _ in range(n):
+        channel = FakeChannel([[d2n.AllInputsClosed()]])
+        channel.release.clear()
+        stream = EventStream(channel)
+        result = {}
+        done = threading.Event()
+
+        def consume():
+            result["v"] = stream.recv()
+            done.set()
+
+        threading.Thread(target=consume, daemon=True).start()
+        time.sleep(0.02)
+        channel.release.set()
+        assert done.wait(timeout=10), "deadlock"
+        assert result["v"] is None
+        stream.close()
+
+
+def test_stream_ended_without_recv():
+    """Poll-only consumers (never calling recv) must see stream_ended
+    become True after AllInputsClosed — the queued sentinel does not
+    count as a pending event."""
+    channel = FakeChannel([[d2n.AllInputsClosed()]])
+    stream = EventStream(channel)
+    deadline = time.time() + 10
+    while not stream.ended and time.time() < deadline:
+        time.sleep(0.02)
+    assert stream.ended
+    # recv still returns the clean end-of-stream after the poll
+    assert stream.recv(timeout=1) is None
+    stream.close()

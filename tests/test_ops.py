@@ -81,6 +81,45 @@ def test_vlm_loss_matches_with_flash(monkeypatch):
     np.testing.assert_allclose(flashed, dense, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_long_context_flat_vmem(causal):
+    """The online-softmax sweep handles T spanning many K blocks (the
+    round-2 kernel held full [T, D] K/V tiles in VMEM and overflowed past
+    T~8k; this kernel's footprint is flat in T). Interpreter-sized here;
+    T=8192/16384 run compiled on TPU via bench_flash.py."""
+    t = 1024
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 1, t, 64), jnp.float32)
+    k = jax.random.normal(kk, (1, 1, t, 64), jnp.float32)
+    v = jax.random.normal(kv, (1, 1, t, 64), jnp.float32)
+    ours = flash_attention(q, k, v, causal=causal)
+    ref = dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="compiled long-T needs a TPU"
+)
+@pytest.mark.parametrize("t", [8192, 16384])
+def test_flash_long_context_tpu(t):
+    key = jax.random.PRNGKey(11)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 2, t, 128), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, 2, t, 128), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, 2, t, 128), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dense_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2
+    )
+
+
 def test_flash_causal_first_row_attends_self_only():
     """Row 0 under causal masking sees exactly key 0 -> output == v[0]."""
     q = jnp.ones((1, 1, 128, 128), jnp.float32)
