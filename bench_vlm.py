@@ -127,6 +127,12 @@ def bench_model(max_new: int = 64, prefill_iters: int = 16,
         donate_argnums=0,
     )
     params = cast(params)
+    int8 = bool(os.environ.get("DORA_INT8_DECODE"))
+    if int8:
+        quantize = jax.jit(
+            lambda p: vlm.quantize_decode(p), donate_argnums=0
+        )
+        params = quantize(params)
     n_params = vlm.param_count(params)
     print(f"# {n_params/1e9:.2f}B params in "
           f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
@@ -189,14 +195,16 @@ def bench_model(max_new: int = 64, prefill_iters: int = 16,
     # reported for completeness but ~0.3% is simply the batch-1 physics.
     # (embedding gather reads one row, not the table; lm_head is already
     # in the matmul count)
-    lm_param_bytes = 2.0 * (lm_matmul_flops_per_token(cfg) / 2)  # bf16
+    bytes_per_param = 1.0 if int8 else 2.0  # int8 vs bf16 resident
+    lm_param_bytes = bytes_per_param * (lm_matmul_flops_per_token(cfg) / 2)
     decode_mbu = lm_param_bytes * tokens_per_s / (PEAK_HBM_GBS * 1e9)
 
+    tag = " int8" if int8 else ""
     _emit("vlm-2b prefill latency", prefill_s * 1e3, "ms",
           backend=backend, prefill_tokens=prefill_tokens)
-    _emit("vlm-2b decode throughput", tokens_per_s, "tokens/s",
+    _emit(f"vlm-2b decode{tag} throughput", tokens_per_s, "tokens/s",
           backend=backend, max_new=max_new)
-    _emit("vlm-2b decode MBU", decode_mbu * 100, "%",
+    _emit(f"vlm-2b decode{tag} MBU", decode_mbu * 100, "%",
           peak_hbm_gbs=PEAK_HBM_GBS)
     _emit("vlm-2b decode MFU", decode_mfu * 100, "%",
           peak_tflops=PEAK_TFLOPS)

@@ -119,10 +119,7 @@ class ShmemConnection(NodeConnection):
         if self._closing:
             return
         self._closing = True
-        try:
-            self.channel.disconnect()
-        except Exception:
-            pass
+        self._disconnect_once()
 
         def _finish(thread=self._thread):
             thread.join(timeout=5)
@@ -130,18 +127,32 @@ class ShmemConnection(NodeConnection):
 
         threading.Thread(target=_finish, daemon=True).start()
 
+    def _disconnect_once(self) -> None:
+        """Disconnect under the close lock: close() (deferred helper) and
+        close_sync() (daemon teardown) can overlap, and a disconnect
+        racing the native free would touch a handle mid-free."""
+        with self._close_lock:
+            if self._channel_closed:
+                return
+            try:
+                self.channel.disconnect()
+            except Exception:
+                pass
+
     def _close_channel_once(self) -> None:
         """Free + unlink the native channel exactly once (the deferred
         close() helper and the synchronous teardown path can both reach
-        here; a double native close would be a double munmap)."""
+        here; a double native close would be a double munmap). The lock
+        is held across the native close so a concurrent
+        ``_disconnect_once`` can never observe the handle mid-free."""
         with self._close_lock:
             if self._channel_closed:
                 return
             self._channel_closed = True
-        try:
-            self.channel.close()
-        except Exception:
-            pass
+            try:
+                self.channel.close()
+            except Exception:
+                pass
 
     def close_sync(self, timeout: float = 2.0) -> None:
         """Close and unlink before returning — the daemon-teardown path.
@@ -152,10 +163,7 @@ class ShmemConnection(NodeConnection):
         is bounded by one recv tick in practice. Safe after close():
         whichever path reaches the native free first wins."""
         self._closing = True
-        try:
-            self.channel.disconnect()
-        except Exception:
-            pass
+        self._disconnect_once()
         self._thread.join(timeout=timeout)
         self._close_channel_once()
 

@@ -1,0 +1,468 @@
+"""InternVL serving pretrained HF checkpoints — second real VLM family.
+
+Faithful to transformers' `InternVLForConditionalGeneration` compute
+graph (the HF-format InternVL2/2.5/3 checkpoints, e.g.
+OpenGVLab/InternVL3-1B-hf):
+
+* vision tower (InternViT): conv patch embed + cls token + learned
+  absolute positions, pre/post-LN blocks with separate q/k/v
+  projections, optional q/k RMSNorm, layer-scale (lambda_1/lambda_2)
+  residuals — no rotary, full self-attention;
+* pixel shuffle: 2x2 spatial neighborhood folded into channels
+  (downsample_ratio 0.5 → 1/4 the tokens at 4x the width), then the
+  LN + 2-layer-MLP multi-modal projector into LM width;
+* language model: Qwen2 (the dora_tpu.models.hf.qwen2 block layout —
+  standard RoPE, GQA, SwiGLU), image features scattered over
+  ``<IMG_CONTEXT>`` token positions.
+
+Tile-based dynamic preprocessing follows the reference node's
+aspect-ratio tiling (closest-ratio grid of 448px tiles + optional
+thumbnail — /root/reference/node-hub/dora-internvl/dora_internvl/
+main.py:28-97); geometry is host-side, per-tile normalize/resize is
+traced JAX.
+
+Numeric parity with the torch implementation is asserted in
+tests/test_hf_parity.py. Reference serves this family through
+torch/CUDA (dora_internvl/main.py:104-121).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dora_tpu.models import layers as L
+from dora_tpu.models.hf import qwen2
+from dora_tpu.models.hf.loader import (
+    linear,
+    maybe_bias,
+    read_config,
+    read_safetensors,
+)
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    depth: int
+    embed_dim: int
+    heads: int
+    ffn: int
+    image_size: int
+    patch_size: int
+    use_qk_norm: bool
+    norm_eps: float
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+@dataclass(frozen=True)
+class InternVLConfig:
+    text: qwen2.Qwen2Config
+    vision: VisionConfig
+    downsample_ratio: float
+    image_token_id: int
+
+    @property
+    def tokens_per_tile(self) -> int:
+        return int(self.vision.n_patches * self.downsample_ratio**2)
+
+    @classmethod
+    def from_hf(cls, config: dict, max_seq: int | None = None) -> "InternVLConfig":
+        vision = config["vision_config"]
+        image_size = vision.get("image_size", [448, 448])
+        patch_size = vision.get("patch_size", [14, 14])
+        if isinstance(image_size, (list, tuple)):
+            image_size = image_size[0]
+        if isinstance(patch_size, (list, tuple)):
+            patch_size = patch_size[0]
+        return cls(
+            text=qwen2.Qwen2Config.from_hf(config["text_config"], max_seq),
+            vision=VisionConfig(
+                depth=vision["num_hidden_layers"],
+                embed_dim=vision["hidden_size"],
+                heads=vision["num_attention_heads"],
+                ffn=vision["intermediate_size"],
+                image_size=image_size,
+                patch_size=patch_size,
+                use_qk_norm=vision.get("use_qk_norm", False),
+                norm_eps=vision.get("layer_norm_eps", 1e-6),
+            ),
+            downsample_ratio=config.get("downsample_ratio", 0.5),
+            image_token_id=config.get("image_token_id", 151667),
+        )
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def load(model_dir: str | Path, max_seq: int | None = None):
+    """(config, params) from an HF-format InternVL checkpoint directory."""
+    hf_config = read_config(model_dir)
+    cfg = InternVLConfig.from_hf(hf_config, max_seq)
+    tensors = read_safetensors(model_dir)
+    return cfg, map_params(tensors, cfg)
+
+
+def map_params(tensors: dict, cfg: InternVLConfig) -> dict:
+    # Two on-disk layouts: the legacy export ("language_model.model.*",
+    # "vision_tower.*", "language_model.lm_head.weight") and the newer
+    # nested one ("model.language_model.*", "model.vision_tower.*",
+    # "lm_head.weight") — transformers maps between them with
+    # InternVLModel._checkpoint_conversion_mapping.
+    if any(k.startswith("model.language_model.") for k in tensors):
+        text_prefix, vt = "model.language_model.", "model.vision_tower."
+        mp = "model.multi_modal_projector."
+    else:
+        text_prefix, vt = "language_model.model.", "vision_tower."
+        mp = "multi_modal_projector."
+        if "language_model.lm_head.weight" in tensors:
+            tensors = dict(tensors)
+            tensors["lm_head.weight"] = tensors["language_model.lm_head.weight"]
+    params = qwen2.map_params(tensors, cfg.text, prefix=text_prefix)
+
+    v = cfg.vision
+    vis: dict[str, Any] = {
+        "cls_token": tensors[vt + "embeddings.cls_token"][0],  # [1, embed]
+        "pos_embed": tensors[vt + "embeddings.position_embeddings"][0],
+        # Conv2d stride == kernel over (c, i, j)-flattened patches is one
+        # matmul: [embed, C, ps, ps] -> [C*ps*ps, embed].
+        "patch_proj": np.ascontiguousarray(
+            tensors[vt + "embeddings.patch_embeddings.projection.weight"]
+            .reshape(v.embed_dim, -1)
+            .T
+        ),
+        "patch_proj_b": tensors[
+            vt + "embeddings.patch_embeddings.projection.bias"
+        ],
+        "blocks": {},
+    }
+    for i in range(v.depth):
+        bp = f"{vt}encoder.layer.{i}."
+        block: dict[str, Any] = {
+            "norm1": tensors[bp + "layernorm_before.weight"],
+            "norm1_b": tensors[bp + "layernorm_before.bias"],
+            "wq": linear(tensors, bp + "attention.q_proj.weight"),
+            "wk": linear(tensors, bp + "attention.k_proj.weight"),
+            "wv": linear(tensors, bp + "attention.v_proj.weight"),
+            "wo": linear(tensors, bp + "attention.projection_layer.weight"),
+            "wo_b": tensors[bp + "attention.projection_layer.bias"],
+            "lambda1": tensors[bp + "lambda_1"],
+            "lambda2": tensors[bp + "lambda_2"],
+            "norm2": tensors[bp + "layernorm_after.weight"],
+            "norm2_b": tensors[bp + "layernorm_after.bias"],
+            "fc1": linear(tensors, bp + "mlp.fc1.weight"),
+            "fc1_b": tensors[bp + "mlp.fc1.bias"],
+            "fc2": linear(tensors, bp + "mlp.fc2.weight"),
+            "fc2_b": tensors[bp + "mlp.fc2.bias"],
+        }
+        maybe_bias(block, "bq", tensors, bp + "attention.q_proj.bias")
+        maybe_bias(block, "bk", tensors, bp + "attention.k_proj.bias")
+        maybe_bias(block, "bv", tensors, bp + "attention.v_proj.bias")
+        if cfg.vision.use_qk_norm:
+            block["q_norm"] = tensors[bp + "attention.q_norm.weight"]
+            block["k_norm"] = tensors[bp + "attention.k_norm.weight"]
+        vis["blocks"][str(i)] = block
+
+    vis["proj_ln"] = tensors[mp + "layer_norm.weight"]
+    vis["proj_ln_b"] = tensors[mp + "layer_norm.bias"]
+    vis["proj_fc1"] = linear(tensors, mp + "linear_1.weight")
+    vis["proj_fc1_b"] = tensors[mp + "linear_1.bias"]
+    vis["proj_fc2"] = linear(tensors, mp + "linear_2.weight")
+    vis["proj_fc2_b"] = tensors[mp + "linear_2.bias"]
+    params["vision"] = jax.tree.map(jnp.asarray, vis)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# vision tower
+# ---------------------------------------------------------------------------
+
+
+def _patchify(pixel_values, ps: int):
+    """[B, C, H, W] -> [B, gh*gw, C*ps*ps] in the conv-kernel's (c, i, j)
+    flattening order."""
+    b, c, h, w = pixel_values.shape
+    gh, gw = h // ps, w // ps
+    x = pixel_values.reshape(b, c, gh, ps, gw, ps)
+    x = x.transpose(0, 2, 4, 1, 3, 5)  # [B, gh, gw, C, ps, ps]
+    return x.reshape(b, gh * gw, c * ps * ps)
+
+
+def _pixel_shuffle(x, scale: float):
+    """transformers' InternVLModel.pixel_shuffle, op for op (input
+    [B, W, H, C] spatial grid; the double transpose keeps orientation)."""
+    b, w, h, c = x.shape
+    x = x.reshape(b, w, int(h * scale), int(c / scale))
+    x = x.transpose(0, 2, 1, 3)
+    x = x.reshape(b, int(h * scale), int(w * scale), int(c / scale**2))
+    return x.transpose(0, 2, 1, 3)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _vision_forward(params, cfg: InternVLConfig, pixel_values):
+    """[B, C, H, W] normalized tiles → projected image tokens
+    [B, tokens_per_tile, lm_dim]."""
+    v = cfg.vision
+    dtype = L.compute_dtype()
+    vp = params["vision"]
+    b = pixel_values.shape[0]
+
+    x = _patchify(pixel_values.astype(dtype), v.patch_size)
+    x = x @ vp["patch_proj"].astype(dtype) + vp["patch_proj_b"].astype(dtype)
+    cls = jnp.broadcast_to(vp["cls_token"].astype(dtype), (b, 1, v.embed_dim))
+    x = jnp.concatenate([cls, x], axis=1)  # [B, 1+P, embed]
+    x = x + vp["pos_embed"].astype(dtype)[None]
+    seq = x.shape[1]
+
+    for i in range(v.depth):
+        bp = vp["blocks"][str(i)]
+        h = L.layer_norm(x, bp["norm1"], bp["norm1_b"], eps=v.norm_eps)
+        q = L.dense(h, bp, "wq", "bq")
+        k = L.dense(h, bp, "wk", "bk")
+        v_ = L.dense(h, bp, "wv", "bv")
+        if "q_norm" in bp:
+            q = L.rms_norm(q, bp["q_norm"], v.norm_eps)
+            k = L.rms_norm(k, bp["k_norm"], v.norm_eps)
+        q, k, v_ = (
+            z.reshape(b, seq, v.heads, v.head_dim).transpose(0, 2, 1, 3)
+            for z in (q, k, v_)
+        )
+        out = L.attention(q, k, v_, None)
+        out = out.transpose(0, 2, 1, 3).reshape(b, seq, v.embed_dim)
+        out = L.dense(out, bp, "wo", "wo_b")
+        x = x + out * bp["lambda1"].astype(dtype)
+        h = L.layer_norm(x, bp["norm2"], bp["norm2_b"], eps=v.norm_eps)
+        h = L.dense(h, bp, "fc1", "fc1_b")
+        h = jax.nn.gelu(h, approximate=False)
+        h = L.dense(h, bp, "fc2", "fc2_b")
+        x = x + h * bp["lambda2"].astype(dtype)
+
+    # select: drop cls, fold to the spatial grid, pixel shuffle, project
+    x = x[:, 1:]
+    fs = v.image_size // v.patch_size
+    x = x.reshape(b, fs, fs, v.embed_dim)
+    x = _pixel_shuffle(x, cfg.downsample_ratio)
+    x = x.reshape(b, -1, x.shape[-1])
+    x = L.layer_norm(x, vp["proj_ln"], vp["proj_ln_b"], eps=1e-5)
+    x = x @ vp["proj_fc1"].astype(dtype) + vp["proj_fc1_b"].astype(dtype)
+    x = jax.nn.gelu(x, approximate=False)
+    return x @ vp["proj_fc2"].astype(dtype) + vp["proj_fc2_b"].astype(dtype)
+
+
+def encode_images(params, cfg: InternVLConfig, pixel_values):
+    """[n_tiles, C, H, W] → image tokens [n_tiles * tokens_per_tile, lm_dim]."""
+    feats = _vision_forward(params, cfg, jnp.asarray(pixel_values))
+    return feats.reshape(-1, feats.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# language model (Qwen2 + scattered image features)
+# ---------------------------------------------------------------------------
+
+
+def _embed_with_images(params, cfg: InternVLConfig, input_ids, image_feats, dtype):
+    h = params["embed"].astype(dtype)[input_ids]  # [B, T, dim]
+    if image_feats is None:
+        return h
+    is_image = input_ids == cfg.image_token_id
+    order = jnp.cumsum(is_image.reshape(-1)) - 1
+    feats = image_feats.astype(dtype)[
+        jnp.clip(order, 0, image_feats.shape[0] - 1)
+    ].reshape(h.shape)
+    return jnp.where(is_image[..., None], feats, h)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def forward(params, cfg: InternVLConfig, input_ids, image_feats):
+    """Teacher-forced logits [B, T, vocab] float32; ``image_feats`` may be
+    None (text-only)."""
+    dtype = L.compute_dtype()
+    b, t = input_ids.shape
+    h = _embed_with_images(params, cfg, input_ids, image_feats, dtype)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = L.causal_mask(t, t)
+    h, _ = qwen2._lm(params, cfg.text, h, positions, mask)
+    return (h @ qwen2._head(params, cfg.text, dtype)).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=(1, 4))
+def _generate_jit(params, cfg: InternVLConfig, input_ids, image_feats,
+                  max_new_tokens: int):
+    tc = cfg.text
+    dtype = L.compute_dtype()
+    b, t = input_ids.shape
+    head = qwen2._head(params, tc, dtype)
+
+    h = _embed_with_images(params, cfg, input_ids, image_feats, dtype)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = L.causal_mask(t, tc.max_seq) & (
+        jnp.arange(tc.max_seq)[None, None, None, :] < t
+    )
+    caches = qwen2.init_cache(tc, b)
+    h, caches = qwen2._lm(
+        params, tc, h, positions, mask, caches=caches, cache_index=0
+    )
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    def step(carry, _):
+        token, caches, position = carry
+        h = params["embed"].astype(dtype)[token][:, None, :]
+        positions = jnp.broadcast_to(position, (b, 1))
+        mask = (jnp.arange(tc.max_seq) <= position)[None, None, None, :]
+        h, caches = qwen2._lm(
+            params, tc, h, positions, mask, caches=caches,
+            cache_index=position,
+        )
+        nxt = jnp.argmax(
+            (h[:, -1] @ head).astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+        return (nxt, caches, position + 1), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (first, caches, jnp.asarray(t, jnp.int32)), None,
+        length=max_new_tokens,
+    )
+    return tokens.T
+
+
+def generate(params, cfg: InternVLConfig, input_ids, pixel_values,
+             max_new_tokens: int):
+    """Greedy generation: prompt ids [B, T] with <IMG_CONTEXT> runs +
+    normalized tiles [n_tiles, C, H, W] → [B, max_new_tokens] int32."""
+    input_ids = np.asarray(input_ids)
+    t = input_ids.shape[1]
+    if t + max_new_tokens > cfg.text.max_seq:
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq ({cfg.text.max_seq}); reload with a larger max_seq"
+        )
+    feats = None
+    if pixel_values is not None:
+        feats = encode_images(params, cfg, pixel_values)
+    return _generate_jit(
+        params, cfg, jnp.asarray(input_ids, jnp.int32), feats, max_new_tokens
+    )
+
+
+# ---------------------------------------------------------------------------
+# tile-based dynamic preprocessing (reference dora_internvl/main.py:28-97)
+# ---------------------------------------------------------------------------
+
+
+def target_ratios(min_num: int = 1, max_num: int = 12) -> list[tuple[int, int]]:
+    """(cols, rows) grids with min_num <= cols*rows <= max_num, area-sorted."""
+    ratios = {
+        (i, j)
+        for n in range(min_num, max_num + 1)
+        for i in range(1, n + 1)
+        for j in range(1, n + 1)
+        if min_num <= i * j <= max_num
+    }
+    return sorted(ratios, key=lambda r: r[0] * r[1])
+
+
+def closest_aspect_ratio(
+    width: int, height: int, ratios: list[tuple[int, int]], tile: int
+) -> tuple[int, int]:
+    """The reference's tie-broken closest-ratio search
+    (dora_internvl/main.py:28-43): nearest aspect ratio; on ties prefer
+    the larger grid when the source image has the pixels to fill it."""
+    aspect = width / height
+    best, best_diff = (1, 1), float("inf")
+    area = width * height
+    for ratio in ratios:
+        diff = abs(aspect - ratio[0] / ratio[1])
+        if diff < best_diff:
+            best, best_diff = ratio, diff
+        elif diff == best_diff and area > 0.5 * tile * tile * ratio[0] * ratio[1]:
+            best = ratio
+    return best
+
+
+def tile_grid(
+    width: int, height: int, tile: int = 448, min_num: int = 1,
+    max_num: int = 12, use_thumbnail: bool = True,
+) -> tuple[int, int, int]:
+    """(cols, rows, n_tiles) for an image — n_tiles includes the thumbnail
+    tile when the grid has more than one tile."""
+    cols, rows = closest_aspect_ratio(
+        width, height, target_ratios(min_num, max_num), tile
+    )
+    blocks = cols * rows
+    return cols, rows, blocks + (1 if use_thumbnail and blocks != 1 else 0)
+
+
+def preprocess_tiles(
+    image, cols: int, rows: int, tile: int = 448, use_thumbnail: bool = True
+):
+    """[H, W, 3] frame (uint8 or float in [0,1]) → normalized tiles
+    [n_tiles, 3, tile, tile]: resize to the (cols, rows) grid, crop
+    row-major tiles, append the thumbnail. Fully traceable (static
+    geometry), matching the reference's resize→crop→normalize chain with
+    jax.image bicubic in place of PIL's."""
+    x = image.astype(jnp.float32)
+    if image.dtype == jnp.uint8:
+        x = x / 255.0
+    grid = jax.image.resize(
+        x, (rows * tile, cols * tile, 3), method="bicubic"
+    )
+    tiles = grid.reshape(rows, tile, cols, tile, 3)
+    tiles = tiles.transpose(0, 2, 1, 3, 4).reshape(-1, tile, tile, 3)
+    if use_thumbnail and cols * rows != 1:
+        thumb = jax.image.resize(x, (tile, tile, 3), method="bicubic")
+        tiles = jnp.concatenate([tiles, thumb[None]], axis=0)
+    mean = jnp.asarray(IMAGENET_MEAN, jnp.float32)
+    std = jnp.asarray(IMAGENET_STD, jnp.float32)
+    tiles = (jnp.clip(tiles, 0.0, 1.0) - mean) / std
+    return tiles.transpose(0, 3, 1, 2)  # [n, C, H, W]
+
+
+def build_prompt_ids(
+    cfg: InternVLConfig, text_ids: list[int], n_tiles: int,
+    start_id: int | None = None, end_id: int | None = None,
+) -> np.ndarray:
+    """Prompt ids with the per-tile <IMG_CONTEXT> runs the checkpoints
+    were trained on; start/end ids wrap the run when the tokenizer
+    provides <img>/</img>."""
+    run = [cfg.image_token_id] * (cfg.tokens_per_tile * n_tiles)
+    ids = ([start_id] if start_id is not None else []) + run + (
+        [end_id] if end_id is not None else []
+    ) + list(text_ids)
+    return np.asarray([ids], dtype=np.int64)
+
+
+def make_serving_step(cfg: InternVLConfig, prompt_ids: np.ndarray,
+                      cols: int, rows: int, tile: int,
+                      max_new_tokens: int):
+    """Fully-traced ``(params, image) -> tokens`` with static tile
+    geometry — the TPU operator-tier shape (one XLA program per tick)."""
+    if prompt_ids.shape[1] + max_new_tokens > cfg.text.max_seq:
+        raise ValueError("prompt + max_new_tokens exceeds max_seq")
+    prompt = jnp.asarray(prompt_ids, jnp.int32)
+
+    def step_fn(params, image):
+        tiles = preprocess_tiles(image, cols, rows, tile)
+        feats = _vision_forward(params, cfg, tiles)
+        feats = feats.reshape(-1, feats.shape[-1])
+        return _generate_jit(params, cfg, prompt, feats, max_new_tokens)
+
+    return step_fn

@@ -242,8 +242,10 @@ def forward(params, cfg: Wav2Vec2Config, audio):
 def speech_probability(params, cfg: Wav2Vec2Config, audio):
     """audio [B, samples] → per-frame speech probability [B, T].
 
-    Frame-classification checkpoints put non-speech in label 0; speech
-    probability = 1 - softmax(logits)[..., 0] (matches how superb/sd
-    heads are read for activity detection)."""
+    Frame-classification heads (superb/sd-class) are multi-label: each
+    label (speaker) gets an independent sigmoid, so speech presence is
+    ``max over labels of sigmoid(logit)``. (A softmax read would pin
+    silence near 0.5 — on silent frames every logit is low but softmax
+    still normalizes to a distribution.)"""
     logits = forward(params, cfg, audio)
-    return 1.0 - jax.nn.softmax(logits, axis=-1)[..., 0]
+    return jnp.max(jax.nn.sigmoid(logits), axis=-1)

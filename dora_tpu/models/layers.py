@@ -64,9 +64,25 @@ def _norm(x, params, prefix: str, kind: str, eps: float):
     return rms_norm(x, params[prefix], eps)
 
 
+def matmul(x, w):
+    """``x @ w`` where ``w`` is a float array or an int8-quantized dict
+    ({"int8", "scale"[, "bf16"]} — see ops.int8_matmul). Matvec-shaped
+    quantized calls (decode) run the Pallas dequant-at-MXU-edge kernel
+    so HBM reads the int8 bytes only; larger-M calls (prefill/training,
+    MXU-bound) prefer the bf16 sidecar when the quantizer kept one."""
+    if isinstance(w, dict):
+        m = math.prod(x.shape[:-1])
+        if m > 32 and "bf16" in w:
+            return x @ w["bf16"].astype(x.dtype)
+        from dora_tpu.ops.int8_matmul import int8_matmul
+
+        return int8_matmul(x, w["int8"], w["scale"])
+    return x @ w.astype(x.dtype)
+
+
 def dense(x, params, w: str, b: str):
     """x @ params[w] (+ params[b] when the checkpoint has the bias)."""
-    out = x @ params[w].astype(x.dtype)
+    out = matmul(x, params[w])
     bias = params.get(b)
     if bias is not None:
         out = out + bias.astype(x.dtype)
@@ -219,9 +235,22 @@ def attention_sublayer(
     dtype = x.dtype
 
     h = _norm(x, params, "attn_norm", norm, norm_eps)
-    q = dense(h, params, "wq", "bq").reshape(b, t, n_heads, head_dim)
-    k = dense(h, params, "wk", "bk").reshape(b, t, n_kv, head_dim)
-    v = dense(h, params, "wv", "bv").reshape(b, t, n_kv, head_dim)
+    if "wqkv" in params:
+        # Decode-fused projection (ops.int8_matmul.quantize_tree fuses
+        # q/k/v into one weight sweep): one kernel call, then split.
+        qkv = dense(h, params, "wqkv", "bqkv")
+        q, k, v = jnp.split(
+            qkv,
+            [n_heads * head_dim, (n_heads + n_kv) * head_dim],
+            axis=-1,
+        )
+    else:
+        q = dense(h, params, "wq", "bq")
+        k = dense(h, params, "wk", "bk")
+        v = dense(h, params, "wv", "bv")
+    q = q.reshape(b, t, n_heads, head_dim)
+    k = k.reshape(b, t, n_kv, head_dim)
+    v = v.reshape(b, t, n_kv, head_dim)
     q, k, v = (z.transpose(0, 2, 1, 3) for z in (q, k, v))  # [B,H,T,D]
 
     if rope is not None:
@@ -281,8 +310,13 @@ def mlp_sublayer(params, x, *, norm="rms", mlp="swiglu", norm_eps=1e-6):
     if mlp == "gelu":
         up = jax.nn.gelu(dense(h, params, "w_up", "b_up"), approximate=False)
         return x + dense(up, params, "w_down", "b_down")
-    gate = jax.nn.silu(dense(h, params, "w_gate", "b_gate"))
-    up = dense(h, params, "w_up", "b_up")
+    if "w_gateup" in params:  # decode-fused (see quantize_tree)
+        fused = dense(h, params, "w_gateup", "b_gateup")
+        gate, up = jnp.split(fused, 2, axis=-1)
+        gate = jax.nn.silu(gate)
+    else:
+        gate = jax.nn.silu(dense(h, params, "w_gate", "b_gate"))
+        up = dense(h, params, "w_up", "b_up")
     return x + dense(gate * up, params, "w_down", "b_down")
 
 

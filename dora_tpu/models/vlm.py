@@ -113,6 +113,29 @@ def param_count(params) -> int:
     return sum(x.size for x in jax.tree.leaves(params))
 
 
+def quantize_decode(params) -> dict:
+    """Int8-quantize the decode-path weights (LM blocks + lm_head).
+
+    The vision tower and embedding are untouched: they run once per
+    frame in prefill (compute-bound), while the LM weights stream from
+    HBM on every generated token (bandwidth-bound — the int8 payoff,
+    see ops.int8_matmul). Serving gate: DORA_INT8_DECODE=1;
+    DORA_INT8_PURE=1 additionally drops the bf16 prefill sidecar
+    (halves LM weight memory, slower prefill).
+    """
+    import os
+
+    from dora_tpu.ops.int8_matmul import quantize_tree
+
+    keep_bf16 = not os.environ.get("DORA_INT8_PURE")
+    out = dict(params)
+    out["blocks"] = quantize_tree(params["blocks"], keep_bf16=keep_bf16)
+    out["lm_head"] = quantize_tree(
+        {"lm_head": params["lm_head"]}, keep_bf16=keep_bf16
+    )["lm_head"]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # vision tower
 # ---------------------------------------------------------------------------
@@ -207,7 +230,7 @@ def prefill(params, cfg: VLMConfig, images, prompt_ids):
     h, caches = _lm_forward(
         params, cfg, h, positions, mask, caches=caches, cache_index=0
     )
-    logits = (h[:, -1] @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    logits = L.matmul(h[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, caches, t
 
 
@@ -221,7 +244,7 @@ def decode_step(params, cfg: VLMConfig, token, caches, position):
     h, caches = _lm_forward(
         params, cfg, h, positions, mask, caches=caches, cache_index=position
     )
-    logits = (h[:, -1] @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    logits = L.matmul(h[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, caches
 
 
@@ -275,7 +298,7 @@ def loss_fn(params, cfg: VLMConfig, batch, mesh=None, ring_axis=None,
     # Score only text positions: logits at [P-1 .. P+T-2] predict tokens.
     p = cfg.n_patches
     h_txt = h[:, p - 1 : p + t - 1]
-    logits = (h_txt @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    logits = L.matmul(h_txt, params["lm_head"]).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)

@@ -323,6 +323,11 @@ class ShmemChannel:
                 self._h, 1 if (self.is_server if unlink is None else unlink) else 0
             )
             self._h = 0
+            # Release the capacity-sized recv scratch now: closed channel
+            # objects can be retained by daemon bookkeeping (a finished
+            # dataflow's conns stay listed for the teardown unlink pass),
+            # and holding 1 MB per finished connection accumulates.
+            self._recv_buf = None
 
     def __enter__(self):
         return self

@@ -168,13 +168,49 @@ def _hf_tokenizer(path: str):
 def make_vlm() -> JaxOperator:
     """Image [H,W,3] -> greedy caption tokens (prompt from DORA_PROMPT).
 
-    With DORA_HF_CHECKPOINT pointing at a Qwen2-VL safetensors directory,
-    serves the real pretrained model (weights + BPE tokenizer); otherwise
-    the self-contained trainable VLM with the byte tokenizer.
+    With DORA_HF_CHECKPOINT pointing at a Qwen2-VL or InternVL
+    safetensors directory, serves the real pretrained model (weights +
+    BPE tokenizer); otherwise the self-contained trainable VLM with the
+    byte tokenizer.
     """
     import jax.numpy as jnp
 
     from dora_tpu.models import tokenizer, vlm
+
+    internvl_path = _hf_checkpoint("internvl")
+    if internvl_path:
+        from dora_tpu.models.hf import internvl
+
+        max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "16"))
+        height = int(os.environ.get("IMAGE_HEIGHT", "224"))
+        width = int(os.environ.get("IMAGE_WIDTH", "224"))
+        max_tiles = int(os.environ.get("DORA_MAX_TILES", "12"))
+        cfg, params = internvl.load(
+            internvl_path, max_seq=int(os.environ.get("DORA_MAX_SEQ", "1024"))
+        )
+        params = _maybe_cast(params)
+        tile = cfg.vision.image_size
+        cols, rows, n_tiles = internvl.tile_grid(
+            width, height, tile=tile, max_num=max_tiles
+        )
+        tok = _hf_tokenizer(internvl_path)
+        prompt_text = os.environ.get("DORA_PROMPT", "Describe this image.")
+        if tok is not None:
+            text_ids = tok.encode(prompt_text)
+        else:
+            text_ids = [t % cfg.text.vocab for t in tokenizer.encode(prompt_text)]
+        prompt_ids = internvl.build_prompt_ids(cfg, text_ids, n_tiles)
+        serve = internvl.make_serving_step(
+            cfg, prompt_ids, cols, rows, tile, max_new
+        )
+
+        def internvl_step(state, inputs):
+            tokens = serve(state, _normalize(inputs["image"]))
+            return state, {"tokens": tokens[0]}
+
+        return JaxOperator(
+            step=internvl_step, init_state=params, sharding=_tp_sharding()
+        )
 
     hf_path = _hf_checkpoint("qwen2_vl")
     if hf_path:
@@ -214,6 +250,11 @@ def make_vlm() -> JaxOperator:
 
     cfg = vlm.VLMConfig.tiny() if _size() == "tiny" else vlm.VLMConfig.bench_2b()
     params = _maybe_restore(vlm.init_params(jax.random.PRNGKey(0), cfg), "vlm")
+    if os.environ.get("DORA_INT8_DECODE"):
+        # Bandwidth lever: int8 LM weights, dequantized at the MXU edge
+        # (ops.int8_matmul). Applied after cast/restore so the stored
+        # float weights are the quantization source.
+        params = vlm.quantize_decode(params)
     prompt_text = os.environ.get("DORA_PROMPT", "describe")
     max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "16"))
     prompt = jnp.asarray(

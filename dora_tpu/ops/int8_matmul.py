@@ -1,0 +1,281 @@
+"""Int8 weight-only dequant-matmul as a Pallas TPU kernel.
+
+Batch-1 decode is HBM-bandwidth-bound: every generated token streams the
+full LM weight set from HBM once, so tokens/s is capped at
+``peak_bandwidth / weight_bytes``. Storing weights in int8 halves the
+bytes vs bf16 — but only if the dequantize happens *at the MXU edge*:
+a naive ``(q * scale).astype(bf16)`` materializes the full bf16 weight
+in HBM first and wins nothing (measured, BENCHMARKS.md round 2). This
+kernel streams int8 blocks HBM→VMEM, converts to the compute dtype
+in-register, runs the MXU dot, and applies the per-output-channel scale
+once on the f32 accumulator — HBM traffic is the int8 bytes, nothing
+else.
+
+Quantization is symmetric per output channel (axis=-1 of the [K, N]
+weight): ``w ≈ q * scale[None, :]`` with q ∈ [-127, 127]. Because the
+scale is per-column it commutes with the matmul —
+``x @ (q·s) == (x @ q) · s`` exactly — so applying it on the
+accumulator is not an approximation.
+
+Reference parity: the reference serves its models through torch/CUDA
+with no quantized path (node-hub/dora-qwenvl/dora_qwenvl/main.py); this
+is a TPU-native extension targeting the decode MBU ceiling.
+
+On non-TPU backends the kernel runs through the Pallas interpreter;
+tests assert parity against the plain-JAX dequantized matmul on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SUBLANE = 16  # bf16 sublane; f32's 8 divides it
+_LANE = 128
+
+# Block sizing is the whole game: each grid step carries fixed overhead
+# (measured ~0.5 us on v5e), so 64 KB blocks cap the sweep at ~130 GB/s
+# while ~2-4 MB blocks reach HBM speed. But padding K/N costs real reads
+# in a bandwidth-bound kernel, so the N block is chosen per shape: the
+# lane multiple nearest ``_TARGET_BYTES / K`` that minimizes padding.
+_TARGET_BYTES = 4 << 20
+#: Above this K the weight panel would not fit VMEM at a useful BN and
+#: the kernel falls back to a sequential K sweep with an accumulator.
+_MAX_BLOCK_K = 16384
+
+
+def quantize_int8(w, keep_bf16: bool = False) -> dict:
+    """[K, N] float -> {"int8": [K, N] int8, "scale": [1, N] f32}.
+
+    Symmetric per-output-channel; returned as a dict so quantized
+    weights flow through parameter pytrees (layers.matmul dispatches on
+    the dict). With ``keep_bf16`` the original weight rides along in
+    bf16: matvec-shaped calls (decode — weight-bandwidth-bound) take the
+    int8 kernel, larger-M calls (prefill/training — MXU-bound, where
+    XLA's plain bf16 matmul is faster than dequant-in-kernel) take the
+    sidecar. Costs 2 extra bytes/param of HBM; drop it where memory is
+    tighter than prefill latency.
+    """
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=0, keepdims=True) / 127.0  # [1, N]
+    scale = jnp.maximum(scale, jnp.float32(1e-12))
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    out = {"int8": q, "scale": scale}
+    if keep_bf16:
+        out["bf16"] = w.astype(jnp.bfloat16)
+    return out
+
+
+def dequantize(wq: dict, dtype=jnp.float32):
+    return (wq["int8"].astype(jnp.float32) * wq["scale"]).astype(dtype)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]  # [M, BK] compute dtype
+    w = q_ref[...].astype(x.dtype)  # int8 -> compute dtype, in VMEM
+    acc_ref[...] += jax.lax.dot(
+        x, w, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[...] = (
+            acc_ref[...] * s_ref[...].astype(jnp.float32)
+        ).astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _best_bn(n: int, bk: int, bn_cap: int) -> int:
+    """Lane-multiple N block <= bn_cap minimizing padded reads, with a
+    mild preference for fewer grid steps."""
+    n128 = _round_up(n, _LANE)
+    if n128 <= bn_cap:
+        return n128
+    best, best_cost = _LANE, None
+    for mult in range(1, bn_cap // _LANE + 1):
+        bn = mult * _LANE
+        waste = _round_up(n128, bn) - n128
+        cost = waste * bk + (n128 // bn + 1) * 4096
+        if best_cost is None or cost < best_cost:
+            best, best_cost = bn, cost
+    return best
+
+
+def _pick_blocks(m_pad: int, k: int, n: int) -> tuple[int, int, int]:
+    """(block_m, block_k, block_n) for x [M, K] @ q [K, N] int8.
+
+    Matvec regime (decode, M <= 32): the kernel is HBM-bound on the
+    weight sweep — K kept whole when it fits (no accumulator sweep),
+    BN targets ~_TARGET_BYTES of int8 per block to amortize the
+    per-grid-step overhead, and padding is minimized because padded
+    columns are real extra reads.
+
+    Compute-bound regime (prefill/training, larger M): weight traffic
+    amortizes over M rows, so fixed MXU-friendly blocks are used and
+    sized to the scoped-VMEM budget (~16 MB with double buffering)
+    instead of chasing bandwidth.
+    """
+    k_pad = _round_up(k, _LANE)
+    if m_pad <= 32:
+        bk = k_pad if k_pad <= _MAX_BLOCK_K else 2048
+        return m_pad, bk, _best_bn(n, bk, max(_TARGET_BYTES // bk, _LANE))
+    bm = min(m_pad, 256)
+    bk = min(k_pad, 2048)
+    # double-buffered VMEM: 2*(x + w + out) + scratch, bytes
+    budget = 10 << 20
+    fixed = 2 * (bm * bk * 2)
+    per_bn = 2 * (bk * 1 + bm * 2) + bm * 4
+    bn_cap = max((budget - fixed) // per_bn // _LANE * _LANE, _LANE)
+    return bm, bk, _best_bn(n, bk, bn_cap)
+
+
+@jax.jit
+def int8_matmul(x, q, scale):
+    """``x @ dequantize(q, scale)`` with int8-only HBM traffic.
+
+    x: [..., K] float; q: [K, N] int8; scale: [1, N] f32.
+    Returns [..., N] in x.dtype (accumulation in f32).
+    """
+    *lead, k = x.shape
+    kq, n = q.shape
+    assert k == kq, (x.shape, q.shape)
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+
+    m_pad = _round_up(max(m, _SUBLANE), _SUBLANE)
+    block_m, block_k, block_n = _pick_blocks(m_pad, k, n)
+    m_pad = _round_up(m_pad, block_m)
+    k_pad = _round_up(k, block_k)
+    n_pad = _round_up(n, block_n)
+    if m_pad != m or k_pad != k:
+        x2 = jnp.pad(x2, ((0, m_pad - m), (0, k_pad - k)))
+    if k_pad != k or n_pad != n:
+        q = jnp.pad(q, ((0, k_pad - k), (0, n_pad - n)))
+    if n_pad != n:
+        scale = jnp.pad(scale, ((0, 0), (0, n_pad - n)))
+
+    nm = m_pad // block_m
+    nn = n_pad // block_n
+    nk = k_pad // block_k
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((block_k, block_n), lambda mi, ni, ki: (ki, ni)),
+            pl.BlockSpec((1, block_n), lambda mi, ni, ki: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_m, block_n), lambda mi, ni, ki: (mi, ni)
+        ),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n_pad), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=jax.default_backend() not in ("tpu",),
+    )(x2, q, scale)
+
+    return out[:m, :n].reshape(*lead, n)
+
+
+# ---------------------------------------------------------------------------
+# parameter-tree quantization
+# ---------------------------------------------------------------------------
+
+#: Weight leaves worth quantizing in a decode path: the per-token matmul
+#: set. Norms, biases, position tables, and the embedding gather stay in
+#: their serving dtype (they are O(dim) reads, not O(dim^2)).
+DECODE_WEIGHTS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
+)
+
+
+def _fusable(params, names) -> bool:
+    return all(
+        n in params
+        and not isinstance(params[n], dict)
+        and getattr(params[n], "ndim", 0) == 2
+        for n in names
+    )
+
+
+def _fuse(params, out, w_names, b_names, w_key, b_key, keep_bf16):
+    """Concatenate the named projections along N into one quantized
+    weight (one kernel sweep instead of len(w_names)); biases concatenate
+    with zero fill for absent segments."""
+    ws = [params[n] for n in w_names]
+    out[w_key] = quantize_int8(
+        jnp.concatenate([jnp.asarray(w) for w in ws], axis=1), keep_bf16
+    )
+    if any(b in params for b in b_names):
+        out[b_key] = jnp.concatenate(
+            [
+                jnp.asarray(params[b])
+                if b in params
+                else jnp.zeros((w.shape[1],), jnp.float32)
+                for b, w in zip(b_names, ws)
+            ]
+        )
+
+
+def quantize_tree(params, names=DECODE_WEIGHTS, keep_bf16: bool = True,
+                  fuse: bool = True):
+    """Replace named 2-D weight leaves with int8-quantized dicts.
+
+    Walks nested dicts; a leaf is quantized when its key is in ``names``
+    and it is a rank-2 float array. Everything else is returned as-is;
+    already-quantized dicts pass through untouched. With ``fuse``,
+    co-resident q/k/v and gate/up projections are concatenated into
+    single ``wqkv`` / ``w_gateup`` weights (layers.attention_sublayer /
+    mlp_sublayer split after the matmul) — decode is kernel-launch-bound
+    at ~100+ calls/token, so halving the call count is worth real
+    tokens/s. ``keep_bf16`` rides the original weights along for the
+    MXU-bound large-M paths (see quantize_int8).
+
+    Note: fused/quantized leaves fall outside the Megatron tp sharding
+    rules (layers.tp_rules matches leaf names) — int8 decode is a
+    single-chip serving configuration.
+    """
+    if not isinstance(params, dict):
+        return params
+    if "int8" in params and "scale" in params:
+        return params
+    out = {}
+    skip: set[str] = set()
+    if fuse and {"wq", "wk", "wv"} <= names and _fusable(params, ("wq", "wk", "wv")):
+        _fuse(params, out, ("wq", "wk", "wv"), ("bq", "bk", "bv"),
+              "wqkv", "bqkv", keep_bf16)
+        skip |= {"wq", "wk", "wv", "bq", "bk", "bv"}
+    if fuse and {"w_gate", "w_up"} <= names and _fusable(params, ("w_gate", "w_up")):
+        _fuse(params, out, ("w_gate", "w_up"), ("b_gate", "b_up"),
+              "w_gateup", "b_gateup", keep_bf16)
+        skip |= {"w_gate", "w_up", "b_gate", "b_up"}
+    for key, value in params.items():
+        if key in skip:
+            continue
+        if (
+            key in names
+            and not isinstance(value, dict)
+            and getattr(value, "ndim", 0) == 2
+            and jnp.issubdtype(value.dtype, jnp.floating)
+        ):
+            out[key] = quantize_int8(value, keep_bf16)
+        else:
+            out[key] = quantize_tree(value, names, keep_bf16, fuse)
+    return out
