@@ -238,6 +238,7 @@ def bench_e2e(tmp: Path, max_new: int = 4, frames: int = 100,
     sink = tmp / "fps_sink.py"
     sink.write_text(textwrap.dedent("""
         import json
+        import statistics
         import time
 
         from dora_tpu.node import Node
@@ -249,9 +250,21 @@ def bench_e2e(tmp: Path, max_new: int = 4, frames: int = 100,
                     continue
                 stamps.append(time.perf_counter())
         assert len(stamps) >= 2, f"only {len(stamps)} outputs"
-        fps = (len(stamps) - 1) / (stamps[-1] - stamps[0])
-        open("fps.json", "w").write(json.dumps(
-            {"fps": fps, "outputs": len(stamps)}))
+        # Steady state: the first outputs straddle the model's jit
+        # compile (no persistent cache reaches the tunneled chip), so
+        # measure after a warmup margin; keep the naive first->last
+        # number for reference.
+        warmup = min(5, len(stamps) - 2)
+        window = stamps[warmup:]
+        fps = (len(window) - 1) / (window[-1] - window[0])
+        gaps = [b - a for a, b in zip(window, window[1:])]
+        open("fps.json", "w").write(json.dumps({
+            "fps": fps,
+            "outputs": len(stamps),
+            "measured_outputs": len(window),
+            "p50_gap_ms": statistics.median(gaps) * 1e3,
+            "fps_incl_warmup": (len(stamps) - 1) / (stamps[-1] - stamps[0]),
+        }))
     """))
     spec = {
         "nodes": [
@@ -282,6 +295,17 @@ def bench_e2e(tmp: Path, max_new: int = 4, frames: int = 100,
                     # Fail loudly rather than silently falling back to a
                     # CPU grind if the chip is held by another process.
                     "JAX_PLATFORMS": "tpu",
+                    # Serving levers under test ride through when set:
+                    # int8 decode weights and async pipelined ticks.
+                    **{
+                        k: os.environ[k]
+                        for k in (
+                            "DORA_INT8_DECODE",
+                            "DORA_INT8_PURE",
+                            "DORA_PIPELINE_DEPTH",
+                        )
+                        if k in os.environ
+                    },
                 },
             },
             {
@@ -300,6 +324,8 @@ def bench_e2e(tmp: Path, max_new: int = 4, frames: int = 100,
     _emit(
         f"camera->vlm-{size} end-to-end FPS ({max_new} new tokens/frame)",
         data["fps"], "fps", outputs=data["outputs"],
+        measured_outputs=data.get("measured_outputs"),
+        p50_gap_ms=round(data.get("p50_gap_ms", 0), 1),
         vs_baseline=data["fps"] / 25.0,  # north star: 25 FPS
     )
     return data

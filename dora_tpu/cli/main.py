@@ -410,7 +410,9 @@ def cmd_runtime(args) -> int:
 def cmd_new(args) -> int:
     from dora_tpu.cli.template import create
 
-    return create(args.kind, args.name, Path(args.path or args.name))
+    return create(
+        args.kind, args.name, Path(args.path or args.name), lang=args.lang
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -516,6 +518,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("kind", choices=["node", "operator", "dataflow"])
     p.add_argument("name")
     p.add_argument("--path", default=None)
+    # Reference parity: --lang rust/python/c/cxx (cli main.rs:96-117);
+    # rust has no toolchain here, the native tier is C/C++.
+    p.add_argument(
+        "--lang", choices=["python", "c", "c++"], default="python",
+        help="scaffold language (c/c++ build against native/ headers)",
+    )
     p.set_defaults(fn=cmd_new)
 
     return parser

@@ -1,13 +1,21 @@
 """`dora-tpu new` project templates.
 
 Reference parity: binaries/cli/src/template/ (rust/python/c/c++ node,
-operator, and dataflow scaffolds) — here Python node, JAX operator, and
-dataflow YAML.
+operator, and dataflow scaffolds, selected with ``--lang`` at
+main.rs:96-117) — here Python node / JAX operator plus C and C++ node
+and operator scaffolds that compile against the headers in ``native/``
+via the dataflow's ``build:`` lines (the cpp-dataflow example pattern).
 """
 
 from __future__ import annotations
 
 from pathlib import Path
+
+
+def _native_dir() -> Path:
+    import dora_tpu
+
+    return Path(dora_tpu.__file__).resolve().parent.parent / "native"
 
 NODE_TEMPLATE = '''"""{name}: a dora-tpu node."""
 
@@ -65,16 +73,189 @@ DATAFLOW_TEMPLATE = """nodes:
 """
 
 
-def create(kind: str, name: str, path: Path) -> int:
+C_NODE_TEMPLATE = '''/* {name}: a dora-tpu node in C (echoes inputs). */
+#include <stdio.h>
+#include "dora_node_api.h"
+
+int main(void) {{
+  DoraContext* ctx = dora_init_from_env();
+  if (!ctx) return 1;
+  DoraEvent* event;
+  while ((event = dora_next_event(ctx)) != NULL) {{
+    DoraEventType type = dora_event_type(event);
+    if (type == DORA_EVENT_STOP) {{
+      dora_event_free(ctx, event);
+      break;
+    }}
+    if (type == DORA_EVENT_INPUT) {{
+      size_t len;
+      const unsigned char* data = dora_event_data(event, &len);
+      if (dora_send_output_enc(ctx, "out", data, len,
+                               dora_event_encoding(event)) != 0) {{
+        fprintf(stderr, "send failed: %s\\n", dora_last_error(ctx));
+      }}
+    }}
+    dora_event_free(ctx, event);
+  }}
+  dora_close(ctx);
+  return 0;
+}}
+'''
+
+CXX_NODE_TEMPLATE = '''// {name}: a dora-tpu node in C++ (echoes inputs).
+#include "dora_node_api.hpp"
+
+int main() {{
+  dora::Node node;
+  while (auto event = node.next()) {{
+    if (event.type() == DORA_EVENT_STOP) break;
+    if (event.type() == DORA_EVENT_INPUT) {{
+      node.send_output("out", event.data(), event.size(),
+                       event.encoding().c_str());
+    }}
+  }}
+  return 0;
+}}
+'''
+
+C_OPERATOR_TEMPLATE = '''/* {name}: a dora-tpu operator in C (C ABI, dlopen-hosted).
+ * extern "C" guard: the build line uses g++, which treats this file as
+ * C++ — the runtime dlopens the unmangled symbol names. */
+#include <stddef.h>
+#include <stdlib.h>
+
+#include "dora_operator_api.h"
+
+typedef struct {{
+  int count;
+}} State;
+
+#ifdef __cplusplus
+extern "C" {{
+#endif
+
+void* dora_init_operator(void) {{
+  State* s = (State*)calloc(1, sizeof(State));
+  return s;
+}}
+
+void dora_drop_operator(void* state) {{ free(state); }}
+
+int dora_on_event(void* state, const DoraOperatorEvent* event,
+                  const DoraOperatorSendOutput* send_output) {{
+  State* s = (State*)state;
+  if (event->type == DORA_OP_EVENT_INPUT) {{
+    s->count++;
+    send_output->send(send_output->context, "out", event->data,
+                      event->data_len, event->encoding);
+  }}
+  return DORA_OP_CONTINUE;
+}}
+
+#ifdef __cplusplus
+}}
+#endif
+'''
+
+CXX_OPERATOR_TEMPLATE = '''// {name}: a dora-tpu operator in C++ (RAII wrapper).
+#include <string>
+
+#include "dora_operator_api.hpp"
+
+class {cls} : public dora::Operator {{
+  int count_ = 0;
+
+  // on_event (not on_input) so the input's encoding can be forwarded —
+  // re-tagging an arrow-ipc payload as "raw" would corrupt it downstream.
+  dora::Status on_event(const dora::Event& event,
+                        dora::OutputSender& out) override {{
+    if (event.type == DORA_OP_EVENT_INPUT) {{
+      ++count_;
+      out.send("out", event.data.data, event.data.len,
+               std::string(event.encoding).c_str());
+    }}
+    return dora::Status::Continue;
+  }}
+}};
+
+DORA_REGISTER_OPERATOR({cls})
+'''
+
+C_DATAFLOW_TEMPLATE = """nodes:
+  - id: source
+    path: module:dora_tpu.nodehub.pyarrow_sender
+    outputs: [data]
+    env: {{DATA: "[1, 2, 3]"}}
+
+  - id: {name}
+    path: ./{name}
+    build: >
+      g++ -O2 -std=c++17 -I {native} {name}.{ext}
+      {native}/node_api.cpp {native}/shmem.cpp
+      -o {name} -lrt -pthread
+    inputs:
+      in: source/data
+    outputs: [out]
+"""
+
+NATIVE_OPERATOR_DATAFLOW_TEMPLATE = """nodes:
+  - id: source
+    path: module:dora_tpu.nodehub.pyarrow_sender
+    outputs: [data]
+    env: {{DATA: "[1, 2, 3]"}}
+
+  - id: {name}
+    operator:
+      shared-library: {name}
+      build: >
+        g++ -O2 -shared -fPIC -std=c++17 -I {native}
+        operator.{ext} -o lib{name}.so
+      inputs:
+        in: source/data
+      outputs: [out]
+"""
+
+
+def create(kind: str, name: str, path: Path, lang: str = "python") -> int:
+    native = _native_dir()
     if kind == "node":
         path.mkdir(parents=True, exist_ok=True)
-        (path / f"{name}.py").write_text(NODE_TEMPLATE.format(name=name))
-        (path / "dataflow.yml").write_text(DATAFLOW_TEMPLATE.format(name=name))
-        print(f"created node project at {path}")
+        if lang == "python":
+            (path / f"{name}.py").write_text(NODE_TEMPLATE.format(name=name))
+            (path / "dataflow.yml").write_text(
+                DATAFLOW_TEMPLATE.format(name=name)
+            )
+        else:
+            ext = "c" if lang == "c" else "cpp"
+            template = C_NODE_TEMPLATE if lang == "c" else CXX_NODE_TEMPLATE
+            (path / f"{name}.{ext}").write_text(template.format(name=name))
+            (path / "dataflow.yml").write_text(
+                C_DATAFLOW_TEMPLATE.format(name=name, native=native, ext=ext)
+            )
+        print(f"created {lang} node project at {path}")
     elif kind == "operator":
         path.mkdir(parents=True, exist_ok=True)
-        (path / "operator.py").write_text(OPERATOR_TEMPLATE.format(name=name))
-        print(f"created operator at {path}")
+        if lang == "python":
+            (path / "operator.py").write_text(
+                OPERATOR_TEMPLATE.format(name=name)
+            )
+        else:
+            ext = "c" if lang == "c" else "cpp"
+            cls = "".join(
+                part.capitalize() for part in name.replace("-", "_").split("_")
+            ) or "Op"
+            template = (
+                C_OPERATOR_TEMPLATE if lang == "c" else CXX_OPERATOR_TEMPLATE
+            )
+            (path / f"operator.{ext}").write_text(
+                template.format(name=name, cls=cls)
+            )
+            (path / "dataflow.yml").write_text(
+                NATIVE_OPERATOR_DATAFLOW_TEMPLATE.format(
+                    name=name, native=native, ext=ext
+                )
+            )
+        print(f"created {lang} operator at {path}")
     else:
         target = path if path.suffix else path / "dataflow.yml"
         target.parent.mkdir(parents=True, exist_ok=True)

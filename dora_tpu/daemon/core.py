@@ -473,7 +473,11 @@ class Daemon:
             for machine in remote_machines:
                 self.inter_daemon_send(df, machine, str(oid), metadata, payload)
 
-        if token is not None and df.tokens[token].pending == 0:
+        # The token can already be gone: a push into a closed/dropping
+        # queue (receiver died mid-dataflow) releases synchronously and
+        # deletes it before we get here.
+        token_state = df.tokens.get(token) if token is not None else None
+        if token_state is not None and token_state.pending == 0:
             del df.tokens[token]
             self._notify_owner(df, sender, token)
 

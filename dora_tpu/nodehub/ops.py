@@ -354,10 +354,52 @@ def make_tts() -> JaxOperator:
     dora_parler/main.py:94-150). ``DORA_TTS_STYLE`` selects the voice
     (the reference's description prompt); output is float32 in [-1, 1]
     at ``cfg.sample_rate``.
+
+    With DORA_HF_CHECKPOINT pointing at a VITS / MMS-TTS safetensors
+    directory, serves the real pretrained model — text bytes are
+    tokenized with the checkpoint's VITS convention (lowercase chars
+    interleaved with pad 0) and synthesized deterministically.
     """
     import jax.numpy as jnp
 
     from dora_tpu.models import tokenizer, tts
+
+    vits_path = _hf_checkpoint("vits")
+    if vits_path:
+        import json
+        from pathlib import Path
+
+        import numpy as np
+
+        from dora_tpu.models.hf import vits
+
+        cfg, params = vits.load(vits_path)
+        vocab_file = Path(vits_path) / "vocab.json"
+        vocab = (
+            json.loads(vocab_file.read_text()) if vocab_file.exists() else None
+        )
+
+        def encode_text(raw: bytes) -> list[int]:
+            text = raw.decode("utf-8", "ignore").lower()
+            if vocab is None:  # no tokenizer shipped: byte-fallback ids
+                ids = [b % cfg.vocab for b in text.encode()]
+            else:
+                ids = [vocab[ch] for ch in text if ch in vocab]
+            # VITS convention: pad token 0 interleaved around each char.
+            out = [0]
+            for t in ids:
+                out += [t, 0]
+            return out
+
+        def vits_step(state, inputs):
+            raw = bytes(np.asarray(inputs["text"]).astype(np.uint8))
+            ids = np.asarray([encode_text(raw)], np.int32)
+            wave = vits.synthesize(state, cfg, ids)
+            return state, {"audio": jnp.asarray(wave[0])}
+
+        # host=True: synthesis length is data-dependent (predicted
+        # durations), so the step cannot run under the fused jit.
+        return JaxOperator(step=vits_step, init_state=params, host=True)
 
     cfg = tts.TTSConfig.tiny() if _size() == "tiny" else tts.TTSConfig()
     params = _maybe_restore(tts.init_params(jax.random.PRNGKey(0), cfg), "tts")

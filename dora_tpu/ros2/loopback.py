@@ -1,0 +1,232 @@
+"""DDS-less loopback transport for the ROS2 bridge.
+
+Environments without a ROS2 installation cannot import rclpy, which left
+``ros2/bridge.py`` (the runtime half of the bridge — reference:
+libraries/extensions/ros2-bridge linking rustdds) unexecuted outside
+ROS2 machines. This module fakes the minimal rclpy surface the bridge
+uses — ``init``/``shutdown``/``create_node``, the single-threaded
+executor, publishers/subscriptions over an in-process topic bus, and
+message classes synthesized from the parsed ``.msg`` specs — so the
+*same* bridge code paths (publish conversion, subscription event-merge
+queue, executor threading) run end to end without DDS.
+
+Usage (tests do this when rclpy is absent)::
+
+    from dora_tpu.ros2.loopback import activate
+    activate()                      # installs fake rclpy + msg modules
+    ctx = Ros2Context()             # bridge code, unchanged
+
+Delivery semantics mirror rclpy: subscription callbacks run on the
+executor's spin thread, not the publisher's.
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import types
+from collections import defaultdict
+
+from dora_tpu.ros2 import find_interface
+
+#: topic -> list of (msg_cls, callback, executor)
+_BUS: dict[str, list] = defaultdict(list)
+_BUS_LOCK = threading.Lock()
+
+
+_PRIMITIVE_DEFAULTS = {
+    "bool": False,
+    "byte": 0,
+    "char": 0,
+    "float32": 0.0,
+    "float64": 0.0,
+    "string": "",
+    "wstring": "",
+}
+
+
+def _default_for(type_ref) -> object:
+    if type_ref.is_array:
+        return []
+    if type_ref.is_primitive:
+        return _PRIMITIVE_DEFAULTS.get(type_ref.base, 0)
+    return None  # nested message: left to the caller
+
+
+def _make_msg_class(package: str, name: str):
+    spec = find_interface(f"{package}/{name}")
+    fields = spec.fields
+
+    def __init__(self):
+        for f in fields:
+            setattr(self, f.name, f.default if f.default is not None
+                    else _default_for(f.type))
+
+    return type(name, (), {"__init__": __init__, "_spec": spec})
+
+
+class _MsgModule(types.ModuleType):
+    """``<pkg>.msg`` module that synthesizes message classes on demand
+    from the parsed interface specs."""
+
+    def __init__(self, package: str):
+        super().__init__(f"{package}.msg")
+        self._package = package
+        self._classes: dict[str, type] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._classes:
+            self._classes[name] = _make_msg_class(self._package, name)
+        return self._classes[name]
+
+
+class _Executor:
+    """SingleThreadedExecutor lookalike: spin() drains a callback queue
+    until shutdown — callbacks run on the spin thread, as in rclpy."""
+
+    def __init__(self):
+        self._work: queue.Queue = queue.Queue()
+        self._shutdown = threading.Event()
+        self._nodes: list = []
+
+    def add_node(self, node) -> None:
+        self._nodes.append(node)
+        node._executor = self
+
+    def spin(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                fn = self._work.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            fn()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    def _post(self, fn) -> None:
+        self._work.put(fn)
+
+
+class _Publisher:
+    def __init__(self, topic: str):
+        self._topic = topic
+
+    def publish(self, msg) -> None:
+        with _BUS_LOCK:
+            targets = list(_BUS[self._topic])
+        for msg_cls, callback, executor in targets:
+            # Copy field-by-field: subscribers must not alias the
+            # publisher's message object (DDS serializes; we mimic).
+            copy = msg_cls()
+            for key, value in vars(msg).items():
+                setattr(copy, key, value)
+            executor._post(lambda cb=callback, m=copy: cb(m))
+
+
+class _Node:
+    def __init__(self, name: str, namespace: str = "/"):
+        self._name = name
+        self._namespace = namespace
+        self._executor: _Executor | None = None
+        self._subscriptions: list[tuple[str, object]] = []
+
+    def create_publisher(self, msg_cls, topic: str, qos_depth: int = 10):
+        return _Publisher(topic)
+
+    def create_subscription(self, msg_cls, topic: str, callback, qos_depth=10):
+        entry = (msg_cls, callback, self._executor)
+        with _BUS_LOCK:
+            _BUS[topic].append(entry)
+        self._subscriptions.append((topic, entry))
+        return entry
+
+    def destroy_node(self) -> None:
+        with _BUS_LOCK:
+            for topic, entry in self._subscriptions:
+                if entry in _BUS[topic]:
+                    _BUS[topic].remove(entry)
+
+
+def _build_rclpy_module():
+    rclpy = types.ModuleType("rclpy")
+    rclpy.__dora_tpu_loopback__ = True
+
+    def init(args=None):
+        pass
+
+    def shutdown():
+        with _BUS_LOCK:
+            _BUS.clear()
+
+    def create_node(name, namespace="/"):
+        return _Node(name, namespace)
+
+    executors = types.ModuleType("rclpy.executors")
+    executors.SingleThreadedExecutor = _Executor
+
+    rclpy.init = init
+    rclpy.shutdown = shutdown
+    rclpy.create_node = create_node
+    rclpy.executors = executors
+    return rclpy, executors
+
+
+def activate() -> None:
+    """Install the loopback rclpy (and on-demand ``<pkg>.msg`` modules)
+    into sys.modules. No-op when a real rclpy is importable — the real
+    DDS transport always wins."""
+    try:
+        import rclpy  # noqa: F401
+
+        if not getattr(rclpy, "__dora_tpu_loopback__", False):
+            return
+    except ImportError:
+        pass
+    rclpy, executors = _build_rclpy_module()
+    sys.modules["rclpy"] = rclpy
+    sys.modules["rclpy.executors"] = executors
+    sys.meta_path.append(_MsgFinder())
+
+
+class _MsgFinder:
+    """Meta-path finder for ``<pkg>.msg`` of packages visible under
+    AMENT_PREFIX_PATH (the bridge does ``__import__("std_msgs.msg")``)."""
+
+    @staticmethod
+    def _ament_has(package: str) -> bool:
+        import os
+        from pathlib import Path
+
+        for prefix in filter(
+            None, os.environ.get("AMENT_PREFIX_PATH", "").split(os.pathsep)
+        ):
+            if (Path(prefix) / "share" / package / "msg").is_dir():
+                return True
+        return False
+
+    def find_spec(self, fullname: str, path=None, target=None):
+        from importlib.machinery import ModuleSpec
+
+        package, _, tail = fullname.partition(".")
+        if tail not in ("", "msg") or not self._ament_has(package):
+            return None
+        return ModuleSpec(
+            fullname, _MsgLoader(), is_package=(tail == "")
+        )
+
+
+class _MsgLoader:
+    def create_module(self, spec):
+        package, _, tail = spec.name.partition(".")
+        if tail == "msg":
+            return _MsgModule(package)
+        module = types.ModuleType(spec.name)
+        module.__path__ = []  # namespace package holding .msg
+        return module
+
+    def exec_module(self, module) -> None:
+        pass

@@ -183,7 +183,23 @@ def run() -> int:
     # camera with queue_size 1 lags the fused model by at most the few
     # in-flight events, never by an unbounded replayed backlog.
     stop_all = False
-    for event in node:
+    while True:
+        # With pipelined ticks in flight, poll instead of parking: a
+        # completed tick's output must reach downstream even when the
+        # trigger stream goes quiet (sparse/event-driven sources).
+        pending = (
+            fused is not None
+            and fused.pipeline_depth > 0
+            and fused.has_in_flight
+        )
+        event = node.recv(timeout=0.01 if pending else None)
+        if event is None:
+            if node.stream_ended:
+                break
+            for outputs in fused.harvest():
+                for out_id, (arr, meta) in outputs.items():
+                    node.send_output(out_id, arr, meta)
+            continue
         if event["type"] == "INPUT":
             op_id, _, input_id = (event["id"] or "").partition("/")
             host = python_hosts.get(op_id)
@@ -199,12 +215,23 @@ def run() -> int:
                 if status == DoraStatus.STOP_ALL:
                     stop_all = True
             elif fused is not None:
-                outputs = fused.on_event(
-                    event["id"], event["value"], event["metadata"]
-                )
-                if outputs:
-                    for out_id, (arr, meta) in outputs.items():
-                        node.send_output(out_id, arr, meta)
+                if fused.pipeline_depth > 0:
+                    # Async serving: dispatch without fetching, then emit
+                    # whatever earlier ticks have completed — the fetch
+                    # round-trip overlaps the next frame's compute.
+                    fused.on_event_async(
+                        event["id"], event["value"], event["metadata"]
+                    )
+                    for outputs in fused.harvest():
+                        for out_id, (arr, meta) in outputs.items():
+                            node.send_output(out_id, arr, meta)
+                else:
+                    outputs = fused.on_event(
+                        event["id"], event["value"], event["metadata"]
+                    )
+                    if outputs:
+                        for out_id, (arr, meta) in outputs.items():
+                            node.send_output(out_id, arr, meta)
         elif event["type"] == "RELOAD":
             target = event.get("operator_id")
             for op_id, host in python_hosts.items():
@@ -221,6 +248,16 @@ def run() -> int:
             and fused is None
         ):
             break
+
+    if fused is not None and fused.pipeline_depth > 0:
+        # Stream end: flush in-flight ticks so the tail frames are
+        # delivered before the node leaves (order preserved).
+        try:
+            for outputs in fused.harvest(block=True):
+                for out_id, (arr, meta) in outputs.items():
+                    node.send_output(out_id, arr, meta)
+        except Exception:
+            logger.exception("pipelined flush failed")
 
     for host in python_hosts.values():
         if not host.stopped:

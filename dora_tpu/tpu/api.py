@@ -43,12 +43,21 @@ class JaxOperator:
     ``sharding`` optionally names a mesh-axis layout for the operator's
     state (applied via jax.sharding when the runtime runs on a mesh; see
     dora_tpu.parallel).
+
+    ``host=True`` marks a host-orchestrated operator: its step runs
+    eagerly outside the fused jit (it may inspect values, branch on
+    data, and call its own jits internally). Needed for models whose
+    output shapes are data-dependent — e.g. VITS TTS, where the frame
+    count comes from predicted durations. Host operators don't fuse
+    with siblings and don't pipeline; everything else about the
+    contract (state threading, Arrow I/O) is identical.
     """
 
     step: Callable[[Any, dict[str, Any]], tuple[Any, dict[str, Any]]]
     init_state: Any = ()
     input_shapes: dict[str, tuple] = field(default_factory=dict)
     sharding: Any = None
+    host: bool = False
 
 
 def load_jax_operator(source: str, working_dir=None) -> JaxOperator:

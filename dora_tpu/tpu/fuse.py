@@ -217,16 +217,55 @@ def mesh_from_env():
     return make_mesh(**axes)
 
 
+def pipeline_depth_from_env() -> int:
+    """In-flight tick budget (DORA_PIPELINE_DEPTH). Default 4 on
+    accelerators: JAX dispatch is asynchronous, so in-flight ticks
+    overlap the device→host fetch with on-device compute of the next
+    frames. Each fetch costs a full host round-trip even for a ready
+    array (~116 ms measured on the axon-tunneled dev chip), but
+    *concurrent* fetches from separate threads amortize it (~17 ms/item
+    at 8-way, measured) — so the harvest fetches on a thread pool and
+    the depth sets how many round-trips amortize. 0 = synchronous (the
+    CPU/test default: interpret-mode ticks are host work and gain
+    nothing)."""
+    import os
+
+    import jax
+
+    value = os.environ.get("DORA_PIPELINE_DEPTH")
+    if value is not None:
+        return max(0, int(value))
+    return 4 if jax.default_backend() in ("tpu", "gpu") else 0
+
+
 class FusedExecutor:
     """Runtime driver of one fused graph: latest-wins input sampling, tick
     triggering, jit with state donation — over a device mesh when
-    ``DORA_MESH`` is set (operator ``sharding`` rules place the state)."""
+    ``DORA_MESH`` is set (operator ``sharding`` rules place the state).
 
-    def __init__(self, graph: FusedGraph, mesh=None):
+    With ``pipeline_depth`` > 0 ticks dispatch asynchronously: the jit
+    call returns device futures immediately, the (states, outputs) pair
+    is queued, and completed outputs are harvested in tick order — frames
+    are pipelined, output order is preserved, and the serving loop never
+    sits idle in a device→host fetch while the chip could be working on
+    the next frame (BASELINE.md north star; the round-2 serial loop spent
+    ~90 ms/frame of tunnel RTT doing exactly that)."""
+
+    def __init__(self, graph: FusedGraph, mesh=None, pipeline_depth=None):
         import jax
 
         self.graph = graph
         self.mesh = mesh if mesh is not None else mesh_from_env()
+        #: a host operator (JaxOperator.host) opts the whole node out of
+        #: tracing: its step branches on data (data-dependent output
+        #: shapes), so the graph runs eagerly and never pipelines.
+        self.eager = any(op.host for op in graph.operators.values())
+        self.pipeline_depth = (
+            pipeline_depth_from_env() if pipeline_depth is None
+            else pipeline_depth
+        )
+        if self.eager:
+            self.pipeline_depth = 0
         self.states = {}
         for op_id, op in graph.operators.items():
             if self.mesh is not None and op.sharding is not None:
@@ -239,6 +278,24 @@ class FusedExecutor:
                 self.states[op_id] = jax.device_put(op.init_state)
         #: latest device value per external data input (latest-wins sampling)
         self.latest: dict[str, Any] = {}
+        #: futures of in-flight tick emissions, oldest first
+        self._in_flight: list[Any] = []
+        self._fetch_pool = None
+        if self.pipeline_depth > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # One worker per in-flight tick: every dispatched tick's
+            # device→host fetch starts immediately on its own thread, so
+            # the round-trips run concurrently instead of serializing on
+            # the event loop (the fetch RPC cost is per-call, not
+            # per-byte, on a tunneled chip). depth+1 workers: the
+            # backpressure check runs after dispatch, so depth+1 ticks
+            # can briefly be in flight and the newest one still needs a
+            # free worker.
+            self._fetch_pool = ThreadPoolExecutor(
+                max_workers=self.pipeline_depth + 1,
+                thread_name_prefix=f"dora-fetch-{graph.node_id}",
+            )
         self._compiled_once = False
         # Donate state so it is updated in place in HBM; on CPU donation is
         # unimplemented and only produces warnings, so skip it there.
@@ -246,7 +303,9 @@ class FusedExecutor:
         step_fn = graph.step_fn
         if self.mesh is not None:
             step_fn = self._meshed(step_fn)
-        self._jit = jax.jit(step_fn, donate_argnums=donate)
+        self._jit = (
+            step_fn if self.eager else jax.jit(step_fn, donate_argnums=donate)
+        )
         self._required = graph.external_inputs - graph.timer_inputs
 
     def _meshed(self, step_fn):
@@ -301,3 +360,45 @@ class FusedExecutor:
         return {
             out_id: device_to_arrow(value) for out_id, value in outputs.items()
         }
+
+    # -- pipelined dispatch (pipeline_depth > 0) ----------------------------
+
+    def on_event_async(self, event_id: str, value, metadata: dict | None) -> None:
+        """Pipelined on_event: dispatch the tick without fetching. The new
+        state chains on-device behind the in-flight computation; results
+        are picked up by :meth:`harvest`."""
+        self.observe(event_id, value, metadata)
+        if event_id not in self.graph.trigger_inputs:
+            return
+        if not all(k in self.latest for k in self._required):
+            return
+        self.states, outputs = self._jit(self.states, dict(self.latest))
+        self._compiled_once = True
+        # The fetch starts NOW on its own thread; the event loop never
+        # blocks in a device→host copy while the queue has headroom.
+        self._in_flight.append(self._fetch_pool.submit(self._emit, outputs))
+        if len(self._in_flight) > self.pipeline_depth:
+            # Backpressure: bound in-flight ticks (and their HBM) by
+            # waiting out the oldest fetch. Its result is not dropped —
+            # it stays queued for the next harvest in order.
+            self._in_flight[0].result()
+
+    def _emit(self, outputs: dict) -> dict:
+        from dora_tpu.tpu.bridge import device_to_arrow
+
+        return {
+            out_id: device_to_arrow(value) for out_id, value in outputs.items()
+        }
+
+    @property
+    def has_in_flight(self) -> bool:
+        return bool(self._in_flight)
+
+    def harvest(self, block: bool = False) -> list[dict]:
+        """Completed tick outputs in dispatch order. Non-blocking by
+        default: drains the queue head while its fetch has finished.
+        ``block`` waits for everything (stream-end flush)."""
+        done: list[dict] = []
+        while self._in_flight and (block or self._in_flight[0].done()):
+            done.append(self._in_flight.pop(0).result())
+        return done

@@ -1,0 +1,116 @@
+"""Spawn a coordinator + two daemons (machines A and B) as separate OS
+processes and run the two-machine dataflow through them.
+
+Reference parity: examples/multiple-daemons/run.rs:29-115 (spawn
+coordinator, spawn one daemon per machine id, start the dataflow over
+the control channel, wait for the result, tear everything down).
+
+    python examples/multiple-daemons/run.py
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from dora_tpu.cli.control import ControlConnection
+from dora_tpu.message import coordinator as cm
+
+HERE = Path(__file__).resolve().parent
+COORD_PORT = 16370
+CONTROL_PORT = 16371
+CONTROL_ADDR = f"127.0.0.1:{CONTROL_PORT}"
+
+
+def spawn(*args: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "dora_tpu.cli.main", *args],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def wait_for(predicate, timeout: float, what: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except OSError:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def machines_connected() -> bool:
+    with ControlConnection(CONTROL_ADDR) as control:
+        reply = control.request(cm.ConnectedMachines())
+        return {"A", "B"} <= set(reply.machines)
+
+
+def main() -> int:
+    procs = [
+        spawn("coordinator", "--port", str(COORD_PORT),
+              "--control-port", str(CONTROL_PORT)),
+    ]
+    try:
+        wait_for(
+            lambda: ControlConnection(CONTROL_ADDR).__enter__() and True,
+            10, "coordinator",
+        )
+        daemon_addr = f"127.0.0.1:{COORD_PORT}"
+        procs += [
+            spawn("daemon", "--coordinator-addr", daemon_addr,
+                  "--machine-id", "A"),
+            spawn("daemon", "--coordinator-addr", daemon_addr,
+                  "--machine-id", "B"),
+        ]
+        wait_for(machines_connected, 15, "daemons A and B")
+
+        import yaml
+
+        with ControlConnection(CONTROL_ADDR) as control:
+            started = control.request(
+                cm.Start(
+                    dataflow=yaml.safe_load(
+                        (HERE / "dataflow.yml").read_text()
+                    ),
+                    name="multi",
+                    local_working_dir=str(HERE),
+                )
+            )
+            if not isinstance(started, cm.DataflowStarted):
+                print(f"start failed: {started}", file=sys.stderr)
+                return 1
+            print(f"dataflow {started.uuid} running on machines A + B")
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with ControlConnection(CONTROL_ADDR) as control:
+                reply = control.request(cm.Check(dataflow_uuid=started.uuid))
+            if isinstance(reply, cm.DataflowStopped):
+                if reply.result.is_ok():
+                    print("dataflow finished successfully across two daemons")
+                    return 0
+                print(f"dataflow failed: {reply.result.errors()}", file=sys.stderr)
+                return 1
+            time.sleep(0.3)
+        print("dataflow did not finish in time", file=sys.stderr)
+        return 1
+    finally:
+        try:
+            with ControlConnection(CONTROL_ADDR) as control:
+                control.request(cm.Destroy())
+        except OSError:
+            pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,15 +77,19 @@ std::string base64_decode(const std::string& in) {
     return -1;
   };
   std::string out;
-  int buf = 0, bits = 0;
+  // Accumulator masked to 24 bits: an unmasked int shifts into the sign
+  // bit on long inputs (UB, caught by UBSan); only the low bits below
+  // `bits` are ever read back.
+  unsigned buf = 0;
+  int bits = 0;
   for (char c : in) {
     int v = val(c);
     if (v < 0) continue;
-    buf = (buf << 6) | v;
+    buf = ((buf << 6) | (unsigned)v) & 0xFFFFFFu;
     bits += 6;
     if (bits >= 8) {
       bits -= 8;
-      out.push_back((char)((buf >> bits) & 0xff));
+      out.push_back((char)((buf >> bits) & 0xffu));
     }
   }
   return out;

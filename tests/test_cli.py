@@ -99,3 +99,38 @@ def test_up_start_logs_destroy(tmp_path, dataflow_yml):
         assert "asserted 2 inputs OK" in logs.stdout
     finally:
         run_cli(["destroy"], tmp_path, check=False)
+
+
+@pytest.mark.parametrize("lang", ["c", "c++"])
+def test_new_native_node_template_builds_and_runs(tmp_path, lang):
+    """`new node --lang c/c++` scaffolds a project whose build: line
+    compiles against native/ and whose dataflow runs end to end
+    (reference: cli template/c + template/cxx)."""
+    proj = tmp_path / "proj"
+    run_cli(["new", "node", "relaynode", "--path", str(proj),
+             "--lang", lang], tmp_path)
+    ext = "c" if lang == "c" else "cpp"
+    assert (proj / f"relaynode.{ext}").exists()
+    run_cli(["build", str(proj / "dataflow.yml")], tmp_path, timeout=120)
+    assert (proj / "relaynode").exists()
+    out = run_cli(
+        ["daemon", "--run-dataflow", str(proj / "dataflow.yml")],
+        tmp_path, timeout=120,
+    )
+    assert "finished successfully" in out.stdout
+
+
+@pytest.mark.parametrize("lang", ["c", "c++"])
+def test_new_native_operator_template_builds_and_runs(tmp_path, lang):
+    proj = tmp_path / "proj"
+    run_cli(["new", "operator", "countop", "--path", str(proj),
+             "--lang", lang], tmp_path)
+    ext = "c" if lang == "c" else "cpp"
+    assert (proj / f"operator.{ext}").exists()
+    run_cli(["build", str(proj / "dataflow.yml")], tmp_path, timeout=120)
+    assert (proj / "libcountop.so").exists()
+    out = run_cli(
+        ["daemon", "--run-dataflow", str(proj / "dataflow.yml")],
+        tmp_path, timeout=120,
+    )
+    assert "finished successfully" in out.stdout

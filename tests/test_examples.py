@@ -1,0 +1,35 @@
+"""The user-facing example directories stay runnable (reference treats
+examples/ as documentation: examples/camera, examples/multiple-daemons/
+run.rs:29-115)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_camera_example(tmp_path):
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dora_tpu.cli.main", "daemon",
+            "--run-dataflow", str(REPO / "examples" / "camera" / "dataflow.yml"),
+        ],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "finished successfully" in proc.stdout
+
+
+def test_multiple_daemons_example(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "multiple-daemons" / "run.py")],
+        capture_output=True, text=True, timeout=180, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "finished successfully across two daemons" in proc.stdout

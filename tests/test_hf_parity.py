@@ -1135,3 +1135,132 @@ def test_internvl_operator_serves_hf_checkpoint(internvl_checkpoint, monkeypatch
             pad_token_id=0,
         ).numpy()[:, input_ids.shape[1] :]
     np.testing.assert_array_equal(tokens[None], theirs)
+
+
+# ---------------------------------------------------------------------------
+# VITS / MMS-TTS (pretrained text-to-speech)
+# ---------------------------------------------------------------------------
+
+
+def _vits_config(stochastic: bool):
+    from transformers import VitsConfig
+
+    return VitsConfig(
+        vocab_size=40,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        ffn_dim=64,
+        ffn_kernel_size=3,
+        window_size=2,
+        flow_size=16,
+        spectrogram_bins=9,
+        duration_predictor_kernel_size=3,
+        duration_predictor_filter_channels=24,
+        use_stochastic_duration_prediction=stochastic,
+        duration_predictor_num_flows=2,
+        duration_predictor_flow_bins=4,
+        depth_separable_num_layers=2,
+        depth_separable_channels=2,
+        prior_encoder_num_flows=2,
+        prior_encoder_num_wavenet_layers=2,
+        wavenet_kernel_size=3,
+        upsample_initial_channel=16,
+        upsample_rates=[4, 4],
+        upsample_kernel_sizes=[8, 8],
+        resblock_kernel_sizes=[3],
+        resblock_dilation_sizes=[[1, 3]],
+        # parity: no sampling noise anywhere
+        noise_scale=0.0,
+        noise_scale_duration=0.0,
+        num_speakers=1,
+        speaker_embedding_size=0,
+    )
+
+
+@pytest.fixture(scope="module", params=[False, True],
+                ids=["plain-duration", "stochastic-duration"])
+def vits_checkpoint(request, tmp_path_factory):
+    from transformers import VitsModel
+
+    torch.manual_seed(31)
+    model = VitsModel(_vits_config(request.param)).eval()
+    path = tmp_path_factory.mktemp(
+        f"vits-tiny-{'sdp' if request.param else 'dp'}"
+    )
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def test_vits_text_encoder_matches_torch(vits_checkpoint):
+    from dora_tpu.models.hf import vits
+
+    path, torch_model = vits_checkpoint
+    cfg, params = vits.load(path)
+    rng = np.random.default_rng(32)
+    ids = rng.integers(1, cfg.vocab, size=(1, 11))
+
+    hidden, means, log_var = vits.encode_text(params, cfg, ids)
+    with torch.no_grad():
+        mask = torch.ones(1, 11, 1)
+        out = torch_model.text_encoder(
+            input_ids=torch.tensor(ids), padding_mask=mask
+        )
+    np.testing.assert_allclose(
+        np.asarray(hidden).transpose(0, 2, 1),
+        out.last_hidden_state.numpy(), atol=2e-4, rtol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(means), out.prior_means.numpy(), atol=2e-4, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(log_var), out.prior_log_variances.numpy(),
+        atol=2e-4, rtol=2e-3,
+    )
+
+
+def test_vits_waveform_matches_torch(vits_checkpoint):
+    """Full deterministic synthesis (noise scales 0): same durations,
+    same waveform as torch VitsModel."""
+    from dora_tpu.models.hf import vits
+
+    path, torch_model = vits_checkpoint
+    cfg, params = vits.load(path)
+    assert cfg.noise_scale == 0.0 and cfg.noise_scale_duration == 0.0
+    rng = np.random.default_rng(33)
+    ids = rng.integers(1, cfg.vocab, size=(1, 7))
+
+    ours = vits.synthesize(params, cfg, ids)
+    with torch.no_grad():
+        theirs = torch_model(input_ids=torch.tensor(ids)).waveform.numpy()
+    assert ours.shape == theirs.shape, (ours.shape, theirs.shape)
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=2e-3)
+
+
+def test_tts_operator_serves_vits_checkpoint(vits_checkpoint, monkeypatch):
+    """make_tts routes VITS checkpoints: the operator's audio equals the
+    torch VitsModel's waveform on the identical token ids."""
+    from dora_tpu.models.hf import vits as vits_mod
+    from dora_tpu.nodehub import ops
+
+    path, torch_model = vits_checkpoint
+    monkeypatch.setenv("DORA_HF_CHECKPOINT", str(path))
+    op = ops.make_tts()
+    _, out = op.step(op.init_state, {"text": jnp.asarray(
+        np.frombuffer(b"hello", dtype=np.uint8))})
+    audio = np.asarray(out["audio"])
+    assert audio.ndim == 1 and audio.size > 0
+    assert np.abs(audio).max() <= 1.0
+
+    # Identical ids through torch (no vocab.json in the fabricated
+    # checkpoint -> the operator's byte-fallback + pad interleave).
+    cfg, _ = vits_mod.load(path)
+    ids = [0]
+    for b in b"hello":
+        ids += [b % cfg.vocab, 0]
+    with torch.no_grad():
+        theirs = torch_model(
+            input_ids=torch.tensor([ids], dtype=torch.long)
+        ).waveform.numpy()[0]
+    assert audio.shape == theirs.shape
+    np.testing.assert_allclose(audio, theirs, atol=1e-4, rtol=2e-3)

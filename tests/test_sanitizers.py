@@ -1,8 +1,15 @@
 """Native layer under ASan/UBSan and TSan (SURVEY §5.2: this build runs
 the C++ under sanitizers in CI, exceeding the reference's cargo-careful
-note). Compiles native/sanitize_test.cpp + shmem.cpp with each sanitizer
-and runs the concurrent server/client exchange; any data race, leak,
-overflow, or UB fails the test through the sanitizer's nonzero exit.
+note). Two tiers:
+
+* sanitize_test.cpp + shmem.cpp — the channel layer's concurrent
+  server/client exchange in one process;
+* the full C node-API client (node_api.cpp: event pump, region cache,
+  drop-token threads) compiled with each sanitizer and run as a real
+  relay node in a shmem dataflow with >4 KiB zero-copy payloads.
+
+Any data race, leak, overflow, or UB fails the test through the
+sanitizer's nonzero exit (the daemon reports the node's exit code).
 """
 
 from __future__ import annotations
@@ -10,6 +17,7 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
+import textwrap
 from pathlib import Path
 
 import pytest
@@ -59,3 +67,83 @@ def test_native_layer_under_sanitizer(tmp_path, name):
     )
     assert proc.returncode == 0, f"{name}:\n{proc.stdout}\n{proc.stderr}"
     assert "sanitize_test ok" in proc.stdout
+
+
+@pytest.mark.parametrize("name", sorted(SANITIZERS))
+def test_c_node_client_under_sanitizer(tmp_path, name):
+    """node_api.cpp under the sanitizer, exercised through a real shmem
+    dataflow: zero-copy region receive, region-backed send, drop-token
+    release threads — the paths the channel-layer test can't reach."""
+    import yaml
+
+    from dora_tpu.daemon import run_dataflow
+    from tests.test_c_node_api import C_RELAY
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    src = tmp_path / "relay.c"
+    src.write_text(textwrap.dedent(C_RELAY))
+    out = tmp_path / f"relay-{name}"
+    cmd = [
+        "g++", "-std=c++17", "-g", "-O1", *SANITIZERS[name],
+        "-I", str(NATIVE),
+        str(src), str(NATIVE / "node_api.cpp"), str(NATIVE / "shmem.cpp"),
+        "-o", str(out), "-lrt", "-pthread",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        if any(
+            marker in proc.stderr
+            for marker in ("cannot find -lasan", "cannot find -ltsan",
+                           "cannot find -lubsan",
+                           "unrecognized command-line option",
+                           "unsupported option")
+        ):
+            pytest.skip(f"g++ cannot link -fsanitize={name} here")
+        raise AssertionError(f"sanitizer build failed:\n{proc.stderr}")
+
+    sender = tmp_path / "big_sender.py"
+    sender.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        payload = bytes(range(256)) * 390 + bytes(160)
+        with Node() as node:
+            for _ in range(3):
+                node.send_output("data", payload)
+    """))
+    checker = tmp_path / "checker.py"
+    checker.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        seen = 0
+        with Node() as node:
+            for event in node:
+                if event["type"] != "INPUT":
+                    continue
+                assert bytes(event["value"]) == (
+                    bytes(range(256)) * 390 + bytes(160)
+                )
+                seen += 1
+        assert seen == 3, seen
+    """))
+    spec = {
+        "nodes": [
+            {"id": "sender", "path": "big_sender.py", "outputs": ["data"]},
+            {
+                "id": "relay",
+                "path": str(out),
+                "inputs": {"in": "sender/data"},
+                "outputs": ["echo"],
+                # Sanitizer runtimes need the env; leak check on for asan.
+                "env": {"ASAN_OPTIONS": "detect_leaks=1"},
+            },
+            {"id": "checker", "path": "checker.py",
+             "inputs": {"in": "relay/echo"}},
+        ],
+        "communication": {"local": "shmem"},
+    }
+    df = tmp_path / "dataflow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    # Sanitized binaries run several times slower; be generous under load.
+    result = run_dataflow(df, local_comm="shmem", timeout_s=300)
+    assert result.is_ok(), result.errors()

@@ -296,3 +296,55 @@ def test_mesh_from_env_partial_spec(monkeypatch):
     assert dict(mesh_from_env().shape) == {"dp": 2, "tp": 2, "sp": 2}
     monkeypatch.delenv("DORA_MESH")
     assert mesh_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# pipelined (async) serving
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_executor_orders_and_flushes(tmp_path):
+    """Async dispatch: outputs harvest in tick order, backpressure bounds
+    in-flight ticks, and a blocking flush delivers the tail."""
+    descriptor = pipeline_descriptor(tmp_path)
+    graph = FusedGraph.build(descriptor.node("pipeline"), descriptor)
+    executor = FusedExecutor(graph, pipeline_depth=2)
+    assert executor.pipeline_depth == 2
+
+    results = []
+    for i in range(5):
+        executor.on_event_async("double/x", pa.array([float(i)]), {})
+        results.extend(executor.harvest())
+    results.extend(executor.harvest(block=True))
+    assert not executor._in_flight
+
+    assert len(results) == 5
+    values = [out["plus/y"][0].to_numpy()[0] for out in results]
+    np.testing.assert_allclose(values, [2 * i + 1 for i in range(5)])
+    # state threaded across all five ticks
+    assert int(np.asarray(executor.states["plus"])) == 5
+
+
+def test_pipelined_executor_warmup_and_non_trigger(tmp_path):
+    """Async path honors warm-up (no tick before every required input) and
+    non-trigger observation semantics."""
+    descriptor = pipeline_descriptor(tmp_path)
+    graph = FusedGraph.build(descriptor.node("pipeline"), descriptor)
+    executor = FusedExecutor(graph, pipeline_depth=2)
+    # unknown (non-trigger) event: records nothing, dispatches nothing
+    executor.on_event_async("double/other", pa.array([1.0]), {})
+    assert not executor._in_flight
+    executor.on_event_async("double/x", pa.array([4.0]), {})
+    out = executor.harvest(block=True)
+    assert len(out) == 1
+    np.testing.assert_allclose(out[0]["plus/y"][0].to_numpy(), [9.0])
+
+
+def test_pipeline_depth_env(monkeypatch):
+    from dora_tpu.tpu import fuse
+
+    monkeypatch.setenv("DORA_PIPELINE_DEPTH", "3")
+    assert fuse.pipeline_depth_from_env() == 3
+    monkeypatch.delenv("DORA_PIPELINE_DEPTH")
+    # CPU backend default: synchronous
+    assert fuse.pipeline_depth_from_env() == 0
