@@ -15,6 +15,7 @@ the prompt → causal LM (RoPE, GQA, SwiGLU) → greedy decode.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any
 
 import jax
@@ -263,11 +264,158 @@ def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (nxt, caches, position + 1), token
 
+    # Unrolling the decode scan amortizes the per-step while-loop
+    # bookkeeping (batch-1 steps are sub-3ms; the loop overhead is a
+    # measurable slice). DORA_DECODE_UNROLL=1 opts out.
+    import os
+
+    # Read at trace time: changing it after the jit cache is warm needs
+    # a process restart. Clamped to >= 1 (0 would crash lax.scan).
+    unroll = max(1, int(os.environ.get("DORA_DECODE_UNROLL", "4")))
     (_, _, _), tokens = jax.lax.scan(
         step, (first, caches, jnp.asarray(position, jnp.int32)), None,
-        length=max_new_tokens,
+        length=max_new_tokens, unroll=min(unroll, max_new_tokens),
     )
     return tokens.T  # [B, max_new]
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (prompt lookup)
+# ---------------------------------------------------------------------------
+
+
+def generate_speculative(params, cfg: VLMConfig, images, prompt_ids,
+                         max_new_tokens: int, k: int = 4, ngram: int = 2):
+    """Greedy generation with prompt-lookup speculation — bit-identical
+    output to :func:`generate`, up to ``k+1`` tokens per model pass.
+
+    Batch-1 decode pays the full LM weight stream per token; verifying a
+    ``k+1``-token chunk costs the same weight traffic as one token, so
+    every accepted draft token is nearly free. Drafts come from the
+    sequence itself (the continuation of the most recent occurrence of
+    the trailing ``ngram``) — no draft model, exact greedy equivalence
+    by construction (every emitted token is an argmax of the full
+    model): camera captions and transcripts are repetitive, which is
+    exactly when batch-1 decode throughput matters.
+
+    The KV cache stays static-shape: each verification writes positions
+    ``p..p+k``; rejected tail entries are provably overwritten before
+    they become attendable (the next chunk starts at the first rejected
+    position). jit-compiled once; B must be 1.
+    """
+    assert prompt_ids.shape[0] == 1, "speculative decode is batch-1"
+    # Exactness guard: the loop must never hit the context limit with
+    # tokens still owed (it would stop early and leave unverified
+    # spillover in the buffer) — same trace-time check as generate(),
+    # plus the k+1 verification headroom.
+    total = cfg.n_patches + prompt_ids.shape[1] + max_new_tokens + k + 1
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({cfg.n_patches}+{prompt_ids.shape[1]}) + "
+            f"max_new_tokens ({max_new_tokens}) + speculation headroom "
+            f"({k + 1}) exceeds max_seq ({cfg.max_seq})"
+        )
+    return _generate_spec_jit(
+        params, cfg, images, prompt_ids, max_new_tokens, k, ngram
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 4, 5, 6))
+def _generate_spec_jit(params, cfg: VLMConfig, images, prompt_ids,
+                       max_new_tokens: int, k: int, ngram: int):
+    dtype = L.compute_dtype()
+    logits, caches, position = prefill(params, cfg, images, prompt_ids)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+
+    seq = cfg.max_seq
+    # Rolling token history for the lookup (prompt + generated).
+    history = jnp.zeros((seq,), jnp.int32)
+    t_prompt = prompt_ids.shape[1]
+    history = jax.lax.dynamic_update_slice(
+        history, prompt_ids[0].astype(jnp.int32), (0,)
+    )
+    history = history.at[t_prompt].set(first[0])
+    hist_len = t_prompt + 1  # tokens known so far (incl. `first`)
+
+    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
+    out = out.at[0].set(first[0])
+
+    def lookup(history, hist_len):
+        """Draft k tokens: continuation of the most recent earlier
+        occurrence of the trailing ngram; falls back to repeating the
+        last token (any draft is safe — acceptance checks correctness)."""
+        tail_start = hist_len - ngram
+        tail = jax.lax.dynamic_slice(history, (jnp.maximum(tail_start, 0),),
+                                     (ngram,))
+        idx = jnp.arange(seq)
+        windows = jnp.stack(
+            [jnp.roll(history, -j) for j in range(ngram)], axis=-1
+        )  # [seq, ngram] = history[i..i+ngram-1]
+        match = jnp.all(windows == tail, axis=-1)
+        # candidate start i must satisfy i + ngram + k <= hist_len and
+        # not be the trailing occurrence itself
+        valid = match & (idx + ngram <= hist_len - 1) & (idx < tail_start)
+        m = jnp.max(jnp.where(valid, idx, -1))
+        start = jnp.clip(m + ngram, 0, seq - k)
+        draft = jax.lax.dynamic_slice(history, (start,), (k,))
+        fallback = jnp.broadcast_to(
+            jax.lax.dynamic_slice(history, (jnp.maximum(hist_len - 1, 0),),
+                                  (1,)), (k,)
+        )
+        return jnp.where(m >= 0, draft, fallback)
+
+    def body(carry):
+        caches, history, hist_len, out, n_emitted, position, _ = carry
+        last = jax.lax.dynamic_slice(out, (n_emitted - 1,), (1,))[0]
+        draft = lookup(history, hist_len)  # [k]
+        chunk = jnp.concatenate([last[None], draft])[None]  # [1, k+1]
+
+        h = params["embed"].astype(dtype)[chunk]
+        positions = position + jnp.arange(k + 1)[None]
+        mask = (
+            jnp.arange(cfg.max_seq)[None, None, None, :]
+            <= positions[0][None, None, :, None]
+        )
+        h, new_caches = _lm_forward(
+            params, cfg, h, positions, mask, caches=caches,
+            cache_index=position,
+        )
+        greedy = jnp.argmax(
+            L.matmul(h[0], params["lm_head"]).astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)  # [k+1]; greedy[i] follows chunk[:i+1]
+
+        agree = greedy[:k] == draft  # draft[i] correct iff == greedy[i]
+        accepted = jnp.argmin(
+            jnp.concatenate([agree, jnp.zeros((1,), bool)])
+        )  # first mismatch index == number of accepted drafts
+        emitted = accepted + 1  # accepted drafts + the bonus token
+
+        out = jax.lax.dynamic_update_slice(out, greedy, (n_emitted,))
+        history = jax.lax.dynamic_update_slice(
+            history,
+            jnp.where(
+                jnp.arange(k + 1) < emitted,
+                greedy,
+                jax.lax.dynamic_slice(history, (hist_len,), (k + 1,)),
+            ),
+            (hist_len,),
+        )
+        return (
+            new_caches, history, hist_len + emitted, out,
+            n_emitted + emitted, position + emitted, carry[6] + 1,
+        )
+
+    def cond(carry):
+        n_emitted, position = carry[4], carry[5]
+        return (n_emitted < max_new_tokens) & (
+            position + k + 1 < cfg.max_seq
+        )
+
+    carry = (caches, history, hist_len, out, jnp.asarray(1, jnp.int32),
+             jnp.asarray(position, jnp.int32), jnp.asarray(1, jnp.int32))
+    carry = jax.lax.while_loop(cond, body, carry)
+    # (tokens [1, max_new], model passes incl. prefill's first token)
+    return carry[3][:max_new_tokens][None], carry[6]
 
 
 # ---------------------------------------------------------------------------

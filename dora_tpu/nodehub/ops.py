@@ -177,6 +177,19 @@ def make_vlm() -> JaxOperator:
 
     from dora_tpu.models import tokenizer, vlm
 
+    if os.environ.get("DORA_SPEC_DECODE") and (
+        _hf_checkpoint("internvl") or _hf_checkpoint("qwen2_vl")
+    ):
+        # Speculation is implemented for the self-contained VLM decode
+        # loop; the pretrained families run vanilla greedy. Loud, not
+        # silent — the env asks for something this path can't do yet.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "DORA_SPEC_DECODE is not supported for pretrained VLM "
+            "checkpoints yet; serving vanilla greedy decode"
+        )
+
     internvl_path = _hf_checkpoint("internvl")
     if internvl_path:
         from dora_tpu.models.hf import internvl
@@ -261,9 +274,31 @@ def make_vlm() -> JaxOperator:
         [[t % cfg.vocab for t in tokenizer.encode(prompt_text)]], jnp.int32
     )
 
+    speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
+    if speculative:
+        # generate_speculative's exactness guard needs k+1 headroom in
+        # max_seq; degrade to vanilla greedy (loudly) when it won't fit.
+        total = cfg.n_patches + prompt.shape[1] + max_new + 5
+        if prompt.shape[0] != 1 or total > cfg.max_seq:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "DORA_SPEC_DECODE disabled: needs batch-1 and %d tokens "
+                "of context (max_seq %d); serving vanilla greedy",
+                total, cfg.max_seq,
+            )
+            speculative = False
+
     def step(state, inputs):
         image = _normalize(inputs["image"])[None]
-        tokens = vlm.generate(state, cfg, image, prompt, max_new)
+        if speculative:
+            # Prompt-lookup speculation: identical greedy tokens, up to
+            # k+1 per model pass (vlm.generate_speculative).
+            tokens, _ = vlm.generate_speculative(
+                state, cfg, image, prompt, max_new
+            )
+        else:
+            tokens = vlm.generate(state, cfg, image, prompt, max_new)
         return state, {"tokens": tokens[0]}
 
     return JaxOperator(step=step, init_state=params, sharding=_tp_sharding())

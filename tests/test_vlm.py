@@ -127,3 +127,47 @@ def test_sharded_train_step_ulysses_sp(monkeypatch):
     step2 = vlm.make_train_step(cfg, opt, mesh=mesh, ring_axis="sp")
     _, _, loss_r = step2(params2, opt.init(params2), batch)
     np.testing.assert_allclose(float(loss_u), float(loss_r), rtol=1e-4)
+
+
+def test_speculative_decode_matches_greedy():
+    """Prompt-lookup speculation emits bit-identical tokens to vanilla
+    greedy decode, in fewer model passes."""
+    import jax
+
+    from dora_tpu.models import vlm
+
+    cfg = vlm.VLMConfig.tiny()
+    params = vlm.init_params(jax.random.PRNGKey(0), cfg)
+    for seed in range(3):
+        image = jax.random.uniform(
+            jax.random.PRNGKey(seed), (1, cfg.image_size, cfg.image_size, 3)
+        )
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(100 + seed), (1, 5), 0, cfg.vocab
+        )
+        vanilla = np.asarray(vlm.generate(params, cfg, image, prompt, 16))
+        spec, passes = vlm.generate_speculative(
+            params, cfg, image, prompt, 16
+        )
+        np.testing.assert_array_equal(vanilla, np.asarray(spec))
+        # Genuinely fewer passes than tokens: fixed seeds make this
+        # deterministic (observed 7-9 passes for 16 tokens); a
+        # regression to zero-acceptance would need exactly 16.
+        assert int(passes) < 16, f"no drafts accepted ({int(passes)} passes)"
+
+
+def test_speculative_decode_context_guard():
+    """Owed tokens must fit max_seq incl. verification headroom — the
+    loop stopping early would break the exact-greedy guarantee."""
+    import jax
+
+    from dora_tpu.models import vlm
+
+    cfg = vlm.VLMConfig.tiny()  # max_seq 64, 16 patches
+    params = vlm.init_params(jax.random.PRNGKey(0), cfg)
+    image = jax.random.uniform(
+        jax.random.PRNGKey(0), (1, cfg.image_size, cfg.image_size, 3)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 10), 0, cfg.vocab)
+    with pytest.raises(ValueError, match="speculation headroom"):
+        vlm.generate_speculative(params, cfg, image, prompt, 40)
