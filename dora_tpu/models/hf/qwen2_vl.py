@@ -456,6 +456,150 @@ def _generate_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding (prompt lookup — see models/vlm.py for the design)
+# ---------------------------------------------------------------------------
+
+
+def generate_speculative(params, cfg: Qwen2VLConfig, input_ids, pixel_values,
+                         grid_thw, max_new_tokens: int, k: int = 4,
+                         ngram: int = 2):
+    """Greedy generation with prompt-lookup speculation — bit-identical
+    to :func:`generate`, up to k+1 tokens per model pass (the
+    verification chunk costs the same LM weight stream as one token).
+    Batch-1 only; text continuation under M-RoPE is uniform (all three
+    axes advance together), so chunk positions are ``delta + i``."""
+    input_ids = np.asarray(input_ids)
+    assert input_ids.shape[0] == 1, "speculative decode is batch-1"
+    t = input_ids.shape[1]
+    if t + max_new_tokens + k + 1 > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) + "
+            f"speculation headroom ({k + 1}) exceeds max_seq ({cfg.max_seq})"
+        )
+    feats = None
+    if pixel_values is not None:
+        feats = encode_images(params, cfg, pixel_values, grid_thw)
+    position_ids, deltas = rope_index(
+        cfg, input_ids, grid_thw if pixel_values is not None else None
+    )
+    return _generate_spec_jit(
+        params, cfg, jnp.asarray(input_ids), feats,
+        jnp.asarray(position_ids), max_new_tokens, jnp.asarray(deltas), k,
+        ngram,
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 5, 7, 8))
+def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
+                       position_ids, max_new_tokens: int, delta, k: int,
+                       ngram: int):
+    dtype = L.compute_dtype()
+    b, t = input_ids.shape
+    head = _head(params, cfg, dtype)
+
+    h = _embed_with_images(params, cfg, input_ids, image_feats, dtype)
+    cos, sin = _mrope_tables(cfg, position_ids)
+    mask = L.causal_mask(t, cfg.max_seq) & (
+        jnp.arange(cfg.max_seq)[None, None, None, :] < t
+    )
+    caches = init_cache(cfg, b)
+    h, caches = _lm(params, cfg, h, cos, sin, mask, caches=caches,
+                    cache_index=0)
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    seq = cfg.max_seq
+    history = jnp.zeros((seq,), jnp.int32)
+    history = jax.lax.dynamic_update_slice(
+        history, input_ids[0].astype(jnp.int32), (0,)
+    )
+    history = history.at[t].set(first[0])
+    hist_len = t + 1
+
+    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
+    out = out.at[0].set(first[0])
+
+    def lookup(history, hist_len):
+        tail_start = hist_len - ngram
+        tail = jax.lax.dynamic_slice(
+            history, (jnp.maximum(tail_start, 0),), (ngram,)
+        )
+        idx = jnp.arange(seq)
+        windows = jnp.stack(
+            [jnp.roll(history, -j) for j in range(ngram)], axis=-1
+        )
+        match = jnp.all(windows == tail, axis=-1)
+        valid = match & (idx + ngram <= hist_len - 1) & (idx < tail_start)
+        m = jnp.max(jnp.where(valid, idx, -1))
+        start = jnp.clip(m + ngram, 0, seq - k)
+        draft = jax.lax.dynamic_slice(history, (start,), (k,))
+        fallback = jnp.broadcast_to(
+            jax.lax.dynamic_slice(
+                history, (jnp.maximum(hist_len - 1, 0),), (1,)
+            ),
+            (k,),
+        )
+        return jnp.where(m >= 0, draft, fallback)
+
+    def body(carry):
+        caches, history, hist_len, out, n_emitted, _ = carry
+        last = jax.lax.dynamic_slice(out, (n_emitted - 1,), (1,))[0]
+        draft = lookup(history, hist_len)
+        chunk = jnp.concatenate([last[None], draft])[None]  # [1, k+1]
+
+        # generated token j (0-based) lives at cache position t + j with
+        # rope position delta + j; `last` is generated index n_emitted-1.
+        gen_idx = n_emitted - 1
+        cache_index = t + gen_idx
+        rope_pos = delta[0] + gen_idx + jnp.arange(k + 1)
+        pos3 = jnp.broadcast_to(rope_pos[None, None], (3, 1, k + 1))
+        ccos, csin = _mrope_tables(cfg, pos3)
+        cache_pos = cache_index + jnp.arange(k + 1)
+        mask = (
+            jnp.arange(cfg.max_seq)[None, None, None, :]
+            <= cache_pos[None, None, :, None]
+        )
+        h = params["embed"].astype(dtype)[chunk]
+        h, new_caches = _lm(
+            params, cfg, h, ccos, csin, mask, caches=caches,
+            cache_index=cache_index,
+        )
+        greedy = jnp.argmax(
+            (h[0] @ head).astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)  # [k+1]
+
+        agree = greedy[:k] == draft
+        accepted = jnp.argmin(
+            jnp.concatenate([agree, jnp.zeros((1,), bool)])
+        )
+        emitted = accepted + 1
+
+        out = jax.lax.dynamic_update_slice(out, greedy, (n_emitted,))
+        history = jax.lax.dynamic_update_slice(
+            history,
+            jnp.where(
+                jnp.arange(k + 1) < emitted,
+                greedy,
+                jax.lax.dynamic_slice(history, (hist_len,), (k + 1,)),
+            ),
+            (hist_len,),
+        )
+        return (
+            new_caches, history, hist_len + emitted, out,
+            n_emitted + emitted, carry[5] + 1,
+        )
+
+    def cond(carry):
+        return carry[4] < max_new_tokens
+
+    carry = (caches, history, hist_len, out, jnp.asarray(1, jnp.int32),
+             jnp.asarray(1, jnp.int32))
+    carry = jax.lax.while_loop(cond, body, carry)
+    return carry[3][:max_new_tokens][None], carry[5]
+
+
+# ---------------------------------------------------------------------------
 # in-graph image preprocessing + serving step (TPU-tier operator path)
 # ---------------------------------------------------------------------------
 
@@ -509,13 +653,17 @@ def preprocess_image(image, cfg: VisionConfig, target_h: int, target_w: int):
 
 
 def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
-                      target_h: int, target_w: int, max_new_tokens: int):
+                      target_h: int, target_w: int, max_new_tokens: int,
+                      speculative: bool = False):
     """Build a fully-traced ``(params, image) -> tokens`` function with a
     static prompt and image geometry — the shape the TPU operator tier
     wants (one XLA program per tick, weights resident in HBM).
 
     ``prompt_ids`` must already contain the ``<|image_pad|>`` run matching
     the image's merged-patch count (use :func:`build_prompt_ids`).
+    ``speculative`` routes decode through prompt-lookup speculation
+    (identical greedy tokens, fewer model passes; needs k+1=5 tokens of
+    max_seq headroom).
     """
     ps = cfg.vision.patch_size
     grid_thw = np.array([[1, target_h // ps, target_w // ps]])
@@ -523,7 +671,8 @@ def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
     cos = jnp.asarray(np.cos(freqs))
     sin = jnp.asarray(np.sin(freqs))
     position_ids, deltas = rope_index(cfg, prompt_ids, grid_thw)
-    if prompt_ids.shape[1] + max_new_tokens > cfg.max_seq:
+    headroom = 5 if speculative else 0
+    if prompt_ids.shape[1] + max_new_tokens + headroom > cfg.max_seq:
         raise ValueError("prompt + max_new_tokens exceeds max_seq")
     prompt = jnp.asarray(prompt_ids, jnp.int32)
     position_ids = jnp.asarray(position_ids)
@@ -532,6 +681,12 @@ def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
     def step_fn(params, image):
         patches = preprocess_image(image, cfg.vision, target_h, target_w)
         feats = _vision_forward(params, cfg.vision, patches, cos, sin, None)
+        if speculative:
+            tokens, _ = _generate_spec_jit(
+                params, cfg, prompt, feats, position_ids, max_new_tokens,
+                deltas, 4, 2,
+            )
+            return tokens
         return _generate_jit(
             params, cfg, prompt, feats, position_ids, max_new_tokens, deltas
         )

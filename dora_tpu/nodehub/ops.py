@@ -177,17 +177,15 @@ def make_vlm() -> JaxOperator:
 
     from dora_tpu.models import tokenizer, vlm
 
-    if os.environ.get("DORA_SPEC_DECODE") and (
-        _hf_checkpoint("internvl") or _hf_checkpoint("qwen2_vl")
-    ):
-        # Speculation is implemented for the self-contained VLM decode
-        # loop; the pretrained families run vanilla greedy. Loud, not
+    if os.environ.get("DORA_SPEC_DECODE") and _hf_checkpoint("internvl"):
+        # Speculation is implemented for the self-contained VLM and the
+        # Qwen2-VL family; InternVL runs vanilla greedy. Loud, not
         # silent — the env asks for something this path can't do yet.
         import logging
 
         logging.getLogger(__name__).warning(
-            "DORA_SPEC_DECODE is not supported for pretrained VLM "
-            "checkpoints yet; serving vanilla greedy decode"
+            "DORA_SPEC_DECODE is not supported for InternVL checkpoints "
+            "yet; serving vanilla greedy decode"
         )
 
     internvl_path = _hf_checkpoint("internvl")
@@ -249,8 +247,19 @@ def make_vlm() -> JaxOperator:
         prompt_ids = qwen2_vl.build_prompt_ids(
             cfg, text_ids, target_h, target_w
         )
+        speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
+        if speculative and prompt_ids.shape[1] + max_new + 5 > cfg.max_seq:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "DORA_SPEC_DECODE disabled: needs %d tokens of max_seq "
+                "(%d); serving vanilla greedy",
+                prompt_ids.shape[1] + max_new + 5, cfg.max_seq,
+            )
+            speculative = False
         serve = qwen2_vl.make_serving_step(
-            cfg, prompt_ids, target_h, target_w, max_new
+            cfg, prompt_ids, target_h, target_w, max_new,
+            speculative=speculative,
         )
 
         def hf_step(state, inputs):

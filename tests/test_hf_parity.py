@@ -1264,3 +1264,49 @@ def test_tts_operator_serves_vits_checkpoint(vits_checkpoint, monkeypatch):
         ).waveform.numpy()[0]
     assert audio.shape == theirs.shape
     np.testing.assert_allclose(audio, theirs, atol=1e-4, rtol=2e-3)
+
+
+def test_qwen2vl_speculative_matches_greedy(qwen2vl_checkpoint):
+    """Prompt-lookup speculation on the pretrained family: bit-identical
+    tokens to vanilla greedy (and therefore to torch), fewer passes."""
+    from dora_tpu.models.hf import qwen2_vl
+
+    path, _ = qwen2vl_checkpoint
+    cfg, params = qwen2_vl.load(path, max_seq=128)
+    rng = np.random.default_rng(44)
+    input_ids, pixel_values, grid_thw = _vlm_inputs(cfg, rng)
+
+    vanilla = np.asarray(
+        qwen2_vl.generate(params, cfg, input_ids, pixel_values, grid_thw, 12)
+    )
+    spec, passes = qwen2_vl.generate_speculative(
+        params, cfg, input_ids, pixel_values, grid_thw, 12
+    )
+    np.testing.assert_array_equal(vanilla, np.asarray(spec))
+    assert int(passes) <= 12
+
+
+def test_vlm_operator_speculative_serving(qwen2vl_checkpoint, monkeypatch):
+    """DORA_SPEC_DECODE on the pretrained operator: same tokens as the
+    vanilla serving step."""
+    from dora_tpu.nodehub import ops
+
+    path, _ = qwen2vl_checkpoint
+    monkeypatch.setenv("DORA_HF_CHECKPOINT", str(path))
+    monkeypatch.setenv("DORA_MAX_NEW_TOKENS", "6")
+    monkeypatch.setenv("DORA_MAX_SEQ", "128")
+    monkeypatch.setenv("IMAGE_HEIGHT", "16")
+    monkeypatch.setenv("IMAGE_WIDTH", "16")
+    monkeypatch.setenv("DORA_PROMPT", "hi")
+    rng = np.random.default_rng(45)
+    image = rng.integers(0, 256, size=(16, 16, 3)).astype(np.uint8)
+
+    op = ops.make_vlm()
+    _, vanilla = op.step(op.init_state, {"image": jnp.asarray(image)})
+
+    monkeypatch.setenv("DORA_SPEC_DECODE", "1")
+    op_spec = ops.make_vlm()
+    _, spec = op_spec.step(op_spec.init_state, {"image": jnp.asarray(image)})
+    np.testing.assert_array_equal(
+        np.asarray(vanilla["tokens"]), np.asarray(spec["tokens"])
+    )
