@@ -364,6 +364,86 @@ def generate(params, cfg: InternVLConfig, input_ids, pixel_values,
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding (prompt lookup — see models/vlm.py for the design)
+# ---------------------------------------------------------------------------
+
+
+def generate_speculative(params, cfg: InternVLConfig, input_ids,
+                         pixel_values, max_new_tokens: int, k: int = 4,
+                         ngram: int = 2):
+    """Greedy generation with prompt-lookup speculation — bit-identical
+    to :func:`generate`, up to k+1 tokens per model pass. Batch-1;
+    standard RoPE, so verification-chunk positions are just t+i."""
+    from dora_tpu.models.spec_decode import check_headroom
+
+    input_ids = np.asarray(input_ids)
+    assert input_ids.shape[0] == 1, "speculative decode is batch-1"
+    check_headroom(input_ids.shape[1], max_new_tokens, cfg.text.max_seq,
+                   "prompt", k)
+    feats = None
+    if pixel_values is not None:
+        feats = encode_images(params, cfg, pixel_values)
+    return _generate_spec_jit(
+        params, cfg, jnp.asarray(input_ids, jnp.int32), feats,
+        max_new_tokens, k, ngram,
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 4, 5, 6))
+def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
+                       max_new_tokens: int, k: int, ngram: int):
+    from dora_tpu.models import spec_decode
+
+    tc = cfg.text
+    dtype = L.compute_dtype()
+    b, t = input_ids.shape
+    head = qwen2._head(params, tc, dtype)
+
+    h = _embed_with_images(params, cfg, input_ids, image_feats, dtype)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = L.causal_mask(t, tc.max_seq) & (
+        jnp.arange(tc.max_seq)[None, None, None, :] < t
+    )
+    caches = qwen2.init_cache(tc, b)
+    h, caches = qwen2._lm(
+        params, tc, h, positions, mask, caches=caches, cache_index=0
+    )
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    history = jnp.zeros((tc.max_seq,), jnp.int32)
+    history = jax.lax.dynamic_update_slice(history, input_ids[0], (0,))
+    history = history.at[t].set(first[0])
+
+    def verify(chunk, n_emitted, caches):
+        # Standard RoPE: generated token j sits at position t + j for
+        # both cache and rotary; chunk[0, 0] is generated index
+        # n_emitted-1.
+        cache_index = t + n_emitted - 1
+        chunk_pos = cache_index + jnp.arange(k + 1)
+        mask = (
+            jnp.arange(tc.max_seq)[None, None, None, :]
+            <= chunk_pos[None, None, :, None]
+        )
+        h = params["embed"].astype(dtype)[chunk]
+        h, new_caches = qwen2._lm(
+            params, tc, h, chunk_pos[None], mask, caches=caches,
+            cache_index=cache_index,
+        )
+        greedy = jnp.argmax(
+            (h[0] @ head).astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+        return greedy, new_caches
+
+    return spec_decode.run_loop(
+        caches=caches, history=history, hist_len=t + 1, first=first[0],
+        max_new_tokens=max_new_tokens, seq=tc.max_seq, verify=verify,
+        k=k, ngram=ngram,
+    )
+
+
+# ---------------------------------------------------------------------------
 # tile-based dynamic preprocessing (reference dora_internvl/main.py:28-97)
 # ---------------------------------------------------------------------------
 
@@ -452,10 +532,15 @@ def build_prompt_ids(
 
 def make_serving_step(cfg: InternVLConfig, prompt_ids: np.ndarray,
                       cols: int, rows: int, tile: int,
-                      max_new_tokens: int):
+                      max_new_tokens: int, speculative: bool = False):
     """Fully-traced ``(params, image) -> tokens`` with static tile
-    geometry — the TPU operator-tier shape (one XLA program per tick)."""
-    if prompt_ids.shape[1] + max_new_tokens > cfg.text.max_seq:
+    geometry — the TPU operator-tier shape (one XLA program per tick).
+    ``speculative`` routes decode through prompt-lookup speculation
+    (identical greedy tokens; needs k+1=5 tokens of max_seq headroom)."""
+    from dora_tpu.models.spec_decode import SPEC_HEADROOM
+
+    headroom = SPEC_HEADROOM if speculative else 0
+    if prompt_ids.shape[1] + max_new_tokens + headroom > cfg.text.max_seq:
         raise ValueError("prompt + max_new_tokens exceeds max_seq")
     prompt = jnp.asarray(prompt_ids, jnp.int32)
 
@@ -463,6 +548,13 @@ def make_serving_step(cfg: InternVLConfig, prompt_ids: np.ndarray,
         tiles = preprocess_tiles(image, cols, rows, tile)
         feats = _vision_forward(params, cfg, tiles)
         feats = feats.reshape(-1, feats.shape[-1])
+        if speculative:
+            from dora_tpu.models.spec_decode import SPEC_K, SPEC_NGRAM
+
+            tokens, _ = _generate_spec_jit(
+                params, cfg, prompt, feats, max_new_tokens, SPEC_K, SPEC_NGRAM
+            )
+            return tokens
         return _generate_jit(params, cfg, prompt, feats, max_new_tokens)
 
     return step_fn

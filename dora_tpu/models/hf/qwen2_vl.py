@@ -468,14 +468,12 @@ def generate_speculative(params, cfg: Qwen2VLConfig, input_ids, pixel_values,
     verification chunk costs the same LM weight stream as one token).
     Batch-1 only; text continuation under M-RoPE is uniform (all three
     axes advance together), so chunk positions are ``delta + i``."""
+    from dora_tpu.models.spec_decode import check_headroom
+
     input_ids = np.asarray(input_ids)
     assert input_ids.shape[0] == 1, "speculative decode is batch-1"
-    t = input_ids.shape[1]
-    if t + max_new_tokens + k + 1 > cfg.max_seq:
-        raise ValueError(
-            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) + "
-            f"speculation headroom ({k + 1}) exceeds max_seq ({cfg.max_seq})"
-        )
+    check_headroom(input_ids.shape[1], max_new_tokens, cfg.max_seq,
+                   "prompt", k)
     feats = None
     if pixel_values is not None:
         feats = encode_images(params, cfg, pixel_values, grid_thw)
@@ -493,6 +491,8 @@ def generate_speculative(params, cfg: Qwen2VLConfig, input_ids, pixel_values,
 def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
                        position_ids, max_new_tokens: int, delta, k: int,
                        ngram: int):
+    from dora_tpu.models import spec_decode
+
     dtype = L.compute_dtype()
     b, t = input_ids.shape
     head = _head(params, cfg, dtype)
@@ -509,47 +509,17 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
         jnp.int32
     )
 
-    seq = cfg.max_seq
-    history = jnp.zeros((seq,), jnp.int32)
+    history = jnp.zeros((cfg.max_seq,), jnp.int32)
     history = jax.lax.dynamic_update_slice(
         history, input_ids[0].astype(jnp.int32), (0,)
     )
     history = history.at[t].set(first[0])
-    hist_len = t + 1
 
-    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
-    out = out.at[0].set(first[0])
-
-    def lookup(history, hist_len):
-        tail_start = hist_len - ngram
-        tail = jax.lax.dynamic_slice(
-            history, (jnp.maximum(tail_start, 0),), (ngram,)
-        )
-        idx = jnp.arange(seq)
-        windows = jnp.stack(
-            [jnp.roll(history, -j) for j in range(ngram)], axis=-1
-        )
-        match = jnp.all(windows == tail, axis=-1)
-        valid = match & (idx + ngram <= hist_len - 1) & (idx < tail_start)
-        m = jnp.max(jnp.where(valid, idx, -1))
-        start = jnp.clip(m + ngram, 0, seq - k)
-        draft = jax.lax.dynamic_slice(history, (start,), (k,))
-        fallback = jnp.broadcast_to(
-            jax.lax.dynamic_slice(
-                history, (jnp.maximum(hist_len - 1, 0),), (1,)
-            ),
-            (k,),
-        )
-        return jnp.where(m >= 0, draft, fallback)
-
-    def body(carry):
-        caches, history, hist_len, out, n_emitted, _ = carry
-        last = jax.lax.dynamic_slice(out, (n_emitted - 1,), (1,))[0]
-        draft = lookup(history, hist_len)
-        chunk = jnp.concatenate([last[None], draft])[None]  # [1, k+1]
-
+    def verify(chunk, n_emitted, caches):
         # generated token j (0-based) lives at cache position t + j with
-        # rope position delta + j; `last` is generated index n_emitted-1.
+        # M-RoPE position delta + j (text continuation advances all
+        # three axes together); chunk[0, 0] is generated index
+        # n_emitted-1.
         gen_idx = n_emitted - 1
         cache_index = t + gen_idx
         rope_pos = delta[0] + gen_idx + jnp.arange(k + 1)
@@ -567,36 +537,14 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
         )
         greedy = jnp.argmax(
             (h[0] @ head).astype(jnp.float32), axis=-1
-        ).astype(jnp.int32)  # [k+1]
+        ).astype(jnp.int32)
+        return greedy, new_caches
 
-        agree = greedy[:k] == draft
-        accepted = jnp.argmin(
-            jnp.concatenate([agree, jnp.zeros((1,), bool)])
-        )
-        emitted = accepted + 1
-
-        out = jax.lax.dynamic_update_slice(out, greedy, (n_emitted,))
-        history = jax.lax.dynamic_update_slice(
-            history,
-            jnp.where(
-                jnp.arange(k + 1) < emitted,
-                greedy,
-                jax.lax.dynamic_slice(history, (hist_len,), (k + 1,)),
-            ),
-            (hist_len,),
-        )
-        return (
-            new_caches, history, hist_len + emitted, out,
-            n_emitted + emitted, carry[5] + 1,
-        )
-
-    def cond(carry):
-        return carry[4] < max_new_tokens
-
-    carry = (caches, history, hist_len, out, jnp.asarray(1, jnp.int32),
-             jnp.asarray(1, jnp.int32))
-    carry = jax.lax.while_loop(cond, body, carry)
-    return carry[3][:max_new_tokens][None], carry[5]
+    return spec_decode.run_loop(
+        caches=caches, history=history, hist_len=t + 1, first=first[0],
+        max_new_tokens=max_new_tokens, seq=cfg.max_seq, verify=verify,
+        k=k, ngram=ngram,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -671,7 +619,9 @@ def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
     cos = jnp.asarray(np.cos(freqs))
     sin = jnp.asarray(np.sin(freqs))
     position_ids, deltas = rope_index(cfg, prompt_ids, grid_thw)
-    headroom = 5 if speculative else 0
+    from dora_tpu.models.spec_decode import SPEC_HEADROOM
+
+    headroom = SPEC_HEADROOM if speculative else 0
     if prompt_ids.shape[1] + max_new_tokens + headroom > cfg.max_seq:
         raise ValueError("prompt + max_new_tokens exceeds max_seq")
     prompt = jnp.asarray(prompt_ids, jnp.int32)
@@ -682,9 +632,11 @@ def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
         patches = preprocess_image(image, cfg.vision, target_h, target_w)
         feats = _vision_forward(params, cfg.vision, patches, cos, sin, None)
         if speculative:
+            from dora_tpu.models.spec_decode import SPEC_K, SPEC_NGRAM
+
             tokens, _ = _generate_spec_jit(
                 params, cfg, prompt, feats, position_ids, max_new_tokens,
-                deltas, 4, 2,
+                deltas, SPEC_K, SPEC_NGRAM,
             )
             return tokens
         return _generate_jit(

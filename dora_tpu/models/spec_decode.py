@@ -1,0 +1,125 @@
+"""Prompt-lookup speculative decoding — the shared loop.
+
+Drafts are the continuation of the most recent earlier occurrence of
+the sequence's trailing ngram (no draft model); a k+1-token
+verification pass costs the same LM weight stream as one decode step,
+so accepted drafts are nearly free, and every emitted token is an
+argmax of the full model — output is bit-identical to vanilla greedy.
+
+Three model families share this loop (models/vlm.py, models/hf/
+qwen2_vl.py, models/hf/internvl.py); each supplies a ``verify``
+closure that runs its own LM over the chunk (the only real difference
+is position bookkeeping: M-RoPE vs standard RoPE). The KV cache stays
+static-shape: verification writes positions p..p+k, and rejected tail
+entries are provably overwritten before they become attendable (the
+next chunk starts at the first rejected position).
+
+Serving gates use ``SPEC_HEADROOM``: the history/out buffers and the
+cache need k+1 tokens of max_seq slack so the loop can never hit the
+context limit with tokens still owed (which would break exactness).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Default draft length / lookup ngram; headroom every gate must check.
+SPEC_K = 4
+SPEC_NGRAM = 2
+SPEC_HEADROOM = SPEC_K + 1
+
+
+def lookup(history, hist_len, seq: int, k: int, ngram: int):
+    """Draft k tokens from the most recent earlier occurrence of the
+    trailing ngram; falls back to repeating the last token (any draft is
+    safe — verification decides acceptance)."""
+    tail_start = hist_len - ngram
+    tail = jax.lax.dynamic_slice(
+        history, (jnp.maximum(tail_start, 0),), (ngram,)
+    )
+    idx = jnp.arange(seq)
+    windows = jnp.stack(
+        [jnp.roll(history, -j) for j in range(ngram)], axis=-1
+    )  # windows[i] = history[i : i+ngram] (wraparound masked below)
+    match = jnp.all(windows == tail, axis=-1)
+    valid = match & (idx + ngram <= hist_len - 1) & (idx < tail_start)
+    m = jnp.max(jnp.where(valid, idx, -1))
+    start = jnp.clip(m + ngram, 0, seq - k)
+    draft = jax.lax.dynamic_slice(history, (start,), (k,))
+    fallback = jnp.broadcast_to(
+        jax.lax.dynamic_slice(history, (jnp.maximum(hist_len - 1, 0),), (1,)),
+        (k,),
+    )
+    return jnp.where(m >= 0, draft, fallback)
+
+
+def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
+             seq: int, verify, k: int = SPEC_K, ngram: int = SPEC_NGRAM):
+    """The speculation while_loop (call inside a jit).
+
+    ``history`` is a [seq] int32 buffer holding the known token ids
+    (prompt text + ``first``); ``hist_len`` is how many are filled.
+    ``verify(chunk [1, k+1] int32, n_emitted, caches) -> (greedy [k+1],
+    new_caches)`` runs the family's LM over the chunk, where greedy[i]
+    is the argmax continuation of the prefix through chunk[0, i], and
+    n_emitted counts tokens emitted so far (``first`` included) — the
+    chunk's first token is generated index n_emitted-1.
+
+    Returns (tokens [1, max_new_tokens], model_passes).
+    """
+    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
+    out = out.at[0].set(first)
+
+    def body(carry):
+        caches, history, hist_len, out, n_emitted, _ = carry
+        last = jax.lax.dynamic_slice(out, (n_emitted - 1,), (1,))[0]
+        draft = lookup(history, hist_len, seq, k, ngram)
+        chunk = jnp.concatenate([last[None], draft])[None]  # [1, k+1]
+
+        greedy, new_caches = verify(chunk, n_emitted, caches)
+
+        agree = greedy[:k] == draft
+        # first mismatch index == number of accepted draft tokens
+        accepted = jnp.argmin(jnp.concatenate([agree, jnp.zeros((1,), bool)]))
+        emitted = accepted + 1  # accepted drafts + the bonus token
+
+        out = jax.lax.dynamic_update_slice(out, greedy, (n_emitted,))
+        history = jax.lax.dynamic_update_slice(
+            history,
+            jnp.where(
+                jnp.arange(k + 1) < emitted,
+                greedy,
+                jax.lax.dynamic_slice(history, (hist_len,), (k + 1,)),
+            ),
+            (hist_len,),
+        )
+        return (
+            new_caches, history, hist_len + emitted, out,
+            n_emitted + emitted, carry[5] + 1,
+        )
+
+    def cond(carry):
+        return carry[4] < max_new_tokens
+
+    carry = (caches, history, hist_len, out, jnp.asarray(1, jnp.int32),
+             jnp.asarray(1, jnp.int32))
+    carry = jax.lax.while_loop(cond, body, carry)
+    return carry[3][:max_new_tokens][None], carry[5]
+
+
+def check_headroom(context_len: int, max_new_tokens: int, max_seq: int,
+                   what: str, k: int = SPEC_K) -> None:
+    """Trace-time exactness guard shared by every entry point."""
+    total = context_len + max_new_tokens + k + 1
+    if total > max_seq:
+        raise ValueError(
+            f"{what} ({context_len}) + max_new_tokens ({max_new_tokens}) "
+            f"+ speculation headroom ({k + 1}) exceeds max_seq ({max_seq})"
+        )
+
+
+def fits(context_len: int, max_new_tokens: int, max_seq: int,
+         k: int = SPEC_K) -> bool:
+    """Gate helper for serving paths that degrade instead of raising."""
+    return context_len + max_new_tokens + k + 1 <= max_seq

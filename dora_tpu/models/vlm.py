@@ -303,18 +303,16 @@ def generate_speculative(params, cfg: VLMConfig, images, prompt_ids,
     they become attendable (the next chunk starts at the first rejected
     position). jit-compiled once; B must be 1.
     """
+    from dora_tpu.models.spec_decode import check_headroom
+
     assert prompt_ids.shape[0] == 1, "speculative decode is batch-1"
     # Exactness guard: the loop must never hit the context limit with
     # tokens still owed (it would stop early and leave unverified
-    # spillover in the buffer) — same trace-time check as generate(),
-    # plus the k+1 verification headroom.
-    total = cfg.n_patches + prompt_ids.shape[1] + max_new_tokens + k + 1
-    if total > cfg.max_seq:
-        raise ValueError(
-            f"prompt ({cfg.n_patches}+{prompt_ids.shape[1]}) + "
-            f"max_new_tokens ({max_new_tokens}) + speculation headroom "
-            f"({k + 1}) exceeds max_seq ({cfg.max_seq})"
-        )
+    # spillover in the buffer). Context = image patches + prompt text.
+    check_headroom(
+        cfg.n_patches + prompt_ids.shape[1], max_new_tokens, cfg.max_seq,
+        "prompt", k,
+    )
     return _generate_spec_jit(
         params, cfg, images, prompt_ids, max_new_tokens, k, ngram
     )
@@ -323,99 +321,46 @@ def generate_speculative(params, cfg: VLMConfig, images, prompt_ids,
 @partial(jax.jit, static_argnums=(1, 4, 5, 6))
 def _generate_spec_jit(params, cfg: VLMConfig, images, prompt_ids,
                        max_new_tokens: int, k: int, ngram: int):
+    from dora_tpu.models import spec_decode
+
     dtype = L.compute_dtype()
     logits, caches, position = prefill(params, cfg, images, prompt_ids)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
 
     seq = cfg.max_seq
-    # Rolling token history for the lookup (prompt + generated).
-    history = jnp.zeros((seq,), jnp.int32)
+    # Rolling token history for the lookup (prompt text + generated).
     t_prompt = prompt_ids.shape[1]
+    history = jnp.zeros((seq,), jnp.int32)
     history = jax.lax.dynamic_update_slice(
         history, prompt_ids[0].astype(jnp.int32), (0,)
     )
     history = history.at[t_prompt].set(first[0])
-    hist_len = t_prompt + 1  # tokens known so far (incl. `first`)
 
-    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
-    out = out.at[0].set(first[0])
-
-    def lookup(history, hist_len):
-        """Draft k tokens: continuation of the most recent earlier
-        occurrence of the trailing ngram; falls back to repeating the
-        last token (any draft is safe — acceptance checks correctness)."""
-        tail_start = hist_len - ngram
-        tail = jax.lax.dynamic_slice(history, (jnp.maximum(tail_start, 0),),
-                                     (ngram,))
-        idx = jnp.arange(seq)
-        windows = jnp.stack(
-            [jnp.roll(history, -j) for j in range(ngram)], axis=-1
-        )  # [seq, ngram] = history[i..i+ngram-1]
-        match = jnp.all(windows == tail, axis=-1)
-        # candidate start i must satisfy i + ngram + k <= hist_len and
-        # not be the trailing occurrence itself
-        valid = match & (idx + ngram <= hist_len - 1) & (idx < tail_start)
-        m = jnp.max(jnp.where(valid, idx, -1))
-        start = jnp.clip(m + ngram, 0, seq - k)
-        draft = jax.lax.dynamic_slice(history, (start,), (k,))
-        fallback = jnp.broadcast_to(
-            jax.lax.dynamic_slice(history, (jnp.maximum(hist_len - 1, 0),),
-                                  (1,)), (k,)
-        )
-        return jnp.where(m >= 0, draft, fallback)
-
-    def body(carry):
-        caches, history, hist_len, out, n_emitted, position, _ = carry
-        last = jax.lax.dynamic_slice(out, (n_emitted - 1,), (1,))[0]
-        draft = lookup(history, hist_len)  # [k]
-        chunk = jnp.concatenate([last[None], draft])[None]  # [1, k+1]
-
-        h = params["embed"].astype(dtype)[chunk]
-        positions = position + jnp.arange(k + 1)[None]
+    def verify(chunk, n_emitted, caches):
+        # generated token j lives at cache position `position + j`
+        # (image patches + prompt precede it); `chunk[0, 0]` is
+        # generated index n_emitted-1.
+        cache_index = position + n_emitted - 1
+        chunk_pos = cache_index + jnp.arange(k + 1)
         mask = (
             jnp.arange(cfg.max_seq)[None, None, None, :]
-            <= positions[0][None, None, :, None]
+            <= chunk_pos[None, None, :, None]
         )
+        h = params["embed"].astype(dtype)[chunk]
         h, new_caches = _lm_forward(
-            params, cfg, h, positions, mask, caches=caches,
-            cache_index=position,
+            params, cfg, h, chunk_pos[None], mask, caches=caches,
+            cache_index=cache_index,
         )
         greedy = jnp.argmax(
             L.matmul(h[0], params["lm_head"]).astype(jnp.float32), axis=-1
-        ).astype(jnp.int32)  # [k+1]; greedy[i] follows chunk[:i+1]
+        ).astype(jnp.int32)
+        return greedy, new_caches
 
-        agree = greedy[:k] == draft  # draft[i] correct iff == greedy[i]
-        accepted = jnp.argmin(
-            jnp.concatenate([agree, jnp.zeros((1,), bool)])
-        )  # first mismatch index == number of accepted drafts
-        emitted = accepted + 1  # accepted drafts + the bonus token
-
-        out = jax.lax.dynamic_update_slice(out, greedy, (n_emitted,))
-        history = jax.lax.dynamic_update_slice(
-            history,
-            jnp.where(
-                jnp.arange(k + 1) < emitted,
-                greedy,
-                jax.lax.dynamic_slice(history, (hist_len,), (k + 1,)),
-            ),
-            (hist_len,),
-        )
-        return (
-            new_caches, history, hist_len + emitted, out,
-            n_emitted + emitted, position + emitted, carry[6] + 1,
-        )
-
-    def cond(carry):
-        n_emitted, position = carry[4], carry[5]
-        return (n_emitted < max_new_tokens) & (
-            position + k + 1 < cfg.max_seq
-        )
-
-    carry = (caches, history, hist_len, out, jnp.asarray(1, jnp.int32),
-             jnp.asarray(position, jnp.int32), jnp.asarray(1, jnp.int32))
-    carry = jax.lax.while_loop(cond, body, carry)
-    # (tokens [1, max_new], model passes incl. prefill's first token)
-    return carry[3][:max_new_tokens][None], carry[6]
+    return spec_decode.run_loop(
+        caches=caches, history=history, hist_len=t_prompt + 1,
+        first=first[0], max_new_tokens=max_new_tokens, seq=seq,
+        verify=verify, k=k, ngram=ngram,
+    )
 
 
 # ---------------------------------------------------------------------------

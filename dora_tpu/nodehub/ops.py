@@ -177,17 +177,6 @@ def make_vlm() -> JaxOperator:
 
     from dora_tpu.models import tokenizer, vlm
 
-    if os.environ.get("DORA_SPEC_DECODE") and _hf_checkpoint("internvl"):
-        # Speculation is implemented for the self-contained VLM and the
-        # Qwen2-VL family; InternVL runs vanilla greedy. Loud, not
-        # silent — the env asks for something this path can't do yet.
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "DORA_SPEC_DECODE is not supported for InternVL checkpoints "
-            "yet; serving vanilla greedy decode"
-        )
-
     internvl_path = _hf_checkpoint("internvl")
     if internvl_path:
         from dora_tpu.models.hf import internvl
@@ -211,8 +200,22 @@ def make_vlm() -> JaxOperator:
         else:
             text_ids = [t % cfg.text.vocab for t in tokenizer.encode(prompt_text)]
         prompt_ids = internvl.build_prompt_ids(cfg, text_ids, n_tiles)
+        speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
+        if speculative:
+            from dora_tpu.models.spec_decode import fits
+
+            if not fits(prompt_ids.shape[1], max_new, cfg.text.max_seq):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "DORA_SPEC_DECODE disabled: speculation headroom "
+                    "exceeds max_seq (%d); serving vanilla greedy",
+                    cfg.text.max_seq,
+                )
+                speculative = False
         serve = internvl.make_serving_step(
-            cfg, prompt_ids, cols, rows, tile, max_new
+            cfg, prompt_ids, cols, rows, tile, max_new,
+            speculative=speculative,
         )
 
         def internvl_step(state, inputs):
@@ -248,15 +251,18 @@ def make_vlm() -> JaxOperator:
             cfg, text_ids, target_h, target_w
         )
         speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
-        if speculative and prompt_ids.shape[1] + max_new + 5 > cfg.max_seq:
-            import logging
+        if speculative:
+            from dora_tpu.models.spec_decode import fits
 
-            logging.getLogger(__name__).warning(
-                "DORA_SPEC_DECODE disabled: needs %d tokens of max_seq "
-                "(%d); serving vanilla greedy",
-                prompt_ids.shape[1] + max_new + 5, cfg.max_seq,
-            )
-            speculative = False
+            if not fits(prompt_ids.shape[1], max_new, cfg.max_seq):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "DORA_SPEC_DECODE disabled: speculation headroom "
+                    "exceeds max_seq (%d); serving vanilla greedy",
+                    cfg.max_seq,
+                )
+                speculative = False
         serve = qwen2_vl.make_serving_step(
             cfg, prompt_ids, target_h, target_w, max_new,
             speculative=speculative,
@@ -285,16 +291,19 @@ def make_vlm() -> JaxOperator:
 
     speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
     if speculative:
-        # generate_speculative's exactness guard needs k+1 headroom in
+        from dora_tpu.models.spec_decode import fits
+
+        # generate_speculative's exactness guard needs SPEC_HEADROOM in
         # max_seq; degrade to vanilla greedy (loudly) when it won't fit.
-        total = cfg.n_patches + prompt.shape[1] + max_new + 5
-        if prompt.shape[0] != 1 or total > cfg.max_seq:
+        if prompt.shape[0] != 1 or not fits(
+            cfg.n_patches + prompt.shape[1], max_new, cfg.max_seq
+        ):
             import logging
 
             logging.getLogger(__name__).warning(
-                "DORA_SPEC_DECODE disabled: needs batch-1 and %d tokens "
-                "of context (max_seq %d); serving vanilla greedy",
-                total, cfg.max_seq,
+                "DORA_SPEC_DECODE disabled: needs batch-1 and speculation "
+                "headroom within max_seq (%d); serving vanilla greedy",
+                cfg.max_seq,
             )
             speculative = False
 
