@@ -382,6 +382,90 @@ def transcribe_tokens(params, cfg: WhisperConfig, input_features, max_new: int,
     return tokens.T
 
 
+def transcribe_tokens_speculative(params, cfg: WhisperConfig, input_features,
+                                  max_new: int, forced_tokens=None,
+                                  k: int | None = None,
+                                  ngram: int | None = None):
+    """Greedy transcription with prompt-lookup speculation — bit-identical
+    to :func:`transcribe_tokens`, up to k+1 tokens per decoder pass
+    (models/spec_decode.py; transcripts are repetitive, which is where
+    autoregressive ASR decode spends its time). Batch-1 only."""
+    from dora_tpu.models.spec_decode import (
+        SPEC_K,
+        SPEC_NGRAM,
+        check_headroom,
+    )
+
+    k = SPEC_K if k is None else k
+    ngram = SPEC_NGRAM if ngram is None else ngram
+    assert input_features.shape[0] == 1, "speculative decode is batch-1"
+    b = input_features.shape[0]
+    if forced_tokens is None:
+        forced_tokens = jnp.full((b, 1), cfg.decoder_start_token, jnp.int32)
+    check_headroom(
+        forced_tokens.shape[1], max_new, cfg.max_target, "forced prefix", k
+    )
+    return _transcribe_spec_jit(
+        params, cfg, input_features, jnp.asarray(forced_tokens, jnp.int32),
+        max_new, k, ngram,
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 4, 5, 6))
+def _transcribe_spec_jit(params, cfg: WhisperConfig, input_features,
+                         forced_tokens, max_new: int, k: int, ngram: int):
+    from dora_tpu.models import spec_decode
+
+    dtype = L.compute_dtype()
+    enc = encode(params, cfg, input_features).astype(dtype)
+    kv = encoder_kv(params, cfg, enc)
+    b, f = forced_tokens.shape
+
+    h = params["embed"].astype(dtype)[forced_tokens]
+    h = h + params["dec_pos"].astype(dtype)[None, :f]
+    mask = L.causal_mask(f, cfg.max_target) & (
+        jnp.arange(cfg.max_target)[None, None, None, :] < f
+    )
+    caches = _dec_cache(cfg, b, dtype)
+    h, caches = _decoder(
+        params, cfg, h, kv, mask, caches=caches, cache_index=0
+    )
+    head = params["embed"].astype(dtype).T
+    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        jnp.int32
+    )
+
+    history = jnp.zeros((cfg.max_target,), jnp.int32)
+    history = jax.lax.dynamic_update_slice(history, forced_tokens[0], (0,))
+    history = history.at[f].set(first[0])
+
+    def verify(chunk, n_emitted, caches):
+        # generated token j sits at decoder position f + j (learned
+        # positions index the same way); chunk[0, 0] is generated index
+        # n_emitted-1.
+        cache_index = f + n_emitted - 1
+        chunk_pos = cache_index + jnp.arange(k + 1)
+        mask = (
+            jnp.arange(cfg.max_target)[None, None, None, :]
+            <= chunk_pos[None, None, :, None]
+        )
+        h = params["embed"].astype(dtype)[chunk]
+        h = h + params["dec_pos"].astype(dtype)[chunk_pos][None]
+        h, new_caches = _decoder(
+            params, cfg, h, kv, mask, caches=caches, cache_index=cache_index
+        )
+        greedy = jnp.argmax(
+            (h[0] @ head).astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+        return greedy, new_caches
+
+    return spec_decode.run_loop(
+        caches=caches, history=history, hist_len=f + 1, first=first[0],
+        max_new_tokens=max_new, seq=cfg.max_target, verify=verify,
+        k=k, ngram=ngram,
+    )
+
+
 def log_mel_traced(audio, n_mels: int, n_fft: int = 400, hop: int = 160,
                    n_samples: int = 480000):
     """Traceable counterpart of :func:`log_mel_features` — audio
@@ -409,9 +493,12 @@ def log_mel_traced(audio, n_mels: int, n_fft: int = 400, hop: int = 160,
 
 
 def make_serving_step(cfg: WhisperConfig, max_new_tokens: int,
-                      forced_tokens: np.ndarray | None = None):
+                      forced_tokens: np.ndarray | None = None,
+                      speculative: bool = False):
     """Build a fully-traced ``(params, audio[samples]) -> tokens`` function
-    (mel → encoder → greedy decode as one XLA program per utterance)."""
+    (mel → encoder → greedy decode as one XLA program per utterance).
+    ``speculative`` routes decode through prompt-lookup speculation
+    (identical greedy tokens, fewer decoder passes)."""
     forced = None if forced_tokens is None else jnp.asarray(
         forced_tokens, jnp.int32
     )
@@ -422,6 +509,11 @@ def make_serving_step(cfg: WhisperConfig, max_new_tokens: int,
         feats = log_mel_traced(
             audio[None].astype(jnp.float32), cfg.n_mels, n_samples=n_samples
         )
+        if speculative:
+            tokens, _ = transcribe_tokens_speculative(
+                params, cfg, feats, max_new_tokens, forced
+            )
+            return tokens
         return transcribe_tokens(params, cfg, feats, max_new_tokens, forced)
 
     return step_fn

@@ -123,3 +123,24 @@ def fits(context_len: int, max_new_tokens: int, max_seq: int,
          k: int = SPEC_K) -> bool:
     """Gate helper for serving paths that degrade instead of raising."""
     return context_len + max_new_tokens + k + 1 <= max_seq
+
+
+def gate_speculation(context_len: int, max_new_tokens: int, max_seq: int,
+                     batch_ok: bool = True) -> bool:
+    """The serving-path DORA_SPEC_DECODE gate, shared by every operator
+    factory: True when the env asks for speculation AND the constraints
+    (batch-1, k+1 headroom within max_seq) allow it; otherwise warns
+    loudly and degrades to vanilla greedy."""
+    import logging
+    import os
+
+    if not os.environ.get("DORA_SPEC_DECODE"):
+        return False
+    if batch_ok and fits(context_len, max_new_tokens, max_seq):
+        return True
+    logging.getLogger(__name__).warning(
+        "DORA_SPEC_DECODE disabled: needs batch-1 and %d tokens of "
+        "context within max_seq (%d); serving vanilla greedy",
+        context_len + max_new_tokens + SPEC_HEADROOM, max_seq,
+    )
+    return False

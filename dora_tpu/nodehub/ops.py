@@ -200,19 +200,11 @@ def make_vlm() -> JaxOperator:
         else:
             text_ids = [t % cfg.text.vocab for t in tokenizer.encode(prompt_text)]
         prompt_ids = internvl.build_prompt_ids(cfg, text_ids, n_tiles)
-        speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
-        if speculative:
-            from dora_tpu.models.spec_decode import fits
+        from dora_tpu.models.spec_decode import gate_speculation
 
-            if not fits(prompt_ids.shape[1], max_new, cfg.text.max_seq):
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "DORA_SPEC_DECODE disabled: speculation headroom "
-                    "exceeds max_seq (%d); serving vanilla greedy",
-                    cfg.text.max_seq,
-                )
-                speculative = False
+        speculative = gate_speculation(
+            prompt_ids.shape[1], max_new, cfg.text.max_seq
+        )
         serve = internvl.make_serving_step(
             cfg, prompt_ids, cols, rows, tile, max_new,
             speculative=speculative,
@@ -250,19 +242,11 @@ def make_vlm() -> JaxOperator:
         prompt_ids = qwen2_vl.build_prompt_ids(
             cfg, text_ids, target_h, target_w
         )
-        speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
-        if speculative:
-            from dora_tpu.models.spec_decode import fits
+        from dora_tpu.models.spec_decode import gate_speculation
 
-            if not fits(prompt_ids.shape[1], max_new, cfg.max_seq):
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "DORA_SPEC_DECODE disabled: speculation headroom "
-                    "exceeds max_seq (%d); serving vanilla greedy",
-                    cfg.max_seq,
-                )
-                speculative = False
+        speculative = gate_speculation(
+            prompt_ids.shape[1], max_new, cfg.max_seq
+        )
         serve = qwen2_vl.make_serving_step(
             cfg, prompt_ids, target_h, target_w, max_new,
             speculative=speculative,
@@ -289,23 +273,12 @@ def make_vlm() -> JaxOperator:
         [[t % cfg.vocab for t in tokenizer.encode(prompt_text)]], jnp.int32
     )
 
-    speculative = bool(os.environ.get("DORA_SPEC_DECODE"))
-    if speculative:
-        from dora_tpu.models.spec_decode import fits
+    from dora_tpu.models.spec_decode import gate_speculation
 
-        # generate_speculative's exactness guard needs SPEC_HEADROOM in
-        # max_seq; degrade to vanilla greedy (loudly) when it won't fit.
-        if prompt.shape[0] != 1 or not fits(
-            cfg.n_patches + prompt.shape[1], max_new, cfg.max_seq
-        ):
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "DORA_SPEC_DECODE disabled: needs batch-1 and speculation "
-                "headroom within max_seq (%d); serving vanilla greedy",
-                cfg.max_seq,
-            )
-            speculative = False
+    speculative = gate_speculation(
+        cfg.n_patches + prompt.shape[1], max_new, cfg.max_seq,
+        batch_ok=prompt.shape[0] == 1,
+    )
 
     def step(state, inputs):
         image = _normalize(inputs["image"])[None]
@@ -336,7 +309,10 @@ def make_asr() -> JaxOperator:
 
         max_new = int(os.environ.get("DORA_MAX_NEW_TOKENS", "32"))
         cfg, params = whisper.load(hf_path)
-        serve = whisper.make_serving_step(cfg, max_new)
+        from dora_tpu.models.spec_decode import gate_speculation
+
+        speculative = gate_speculation(1, max_new, cfg.max_target)
+        serve = whisper.make_serving_step(cfg, max_new, speculative=speculative)
 
         def hf_step(state, inputs):
             tokens = serve(state, inputs["audio"])

@@ -1332,3 +1332,24 @@ def test_internvl_speculative_matches_greedy(internvl_checkpoint):
     # Strictly fewer passes than tokens (deterministic fixture seeds):
     # a zero-acceptance regression would need exactly 12.
     assert int(passes) < 12, f"no drafts accepted ({int(passes)} passes)"
+
+
+def test_whisper_speculative_matches_greedy(whisper_checkpoint):
+    """Prompt-lookup speculation on ASR: bit-identical transcript tokens
+    to vanilla greedy, fewer decoder passes."""
+    from dora_tpu.models.hf import whisper
+
+    path, _ = whisper_checkpoint
+    cfg, params = whisper.load(path)
+    rng = np.random.default_rng(47)
+    feats = rng.normal(size=(1, cfg.n_mels, 2 * cfg.max_source)).astype(
+        np.float32
+    )
+
+    vanilla = np.asarray(whisper.transcribe_tokens(params, cfg, feats, 16))
+    spec, passes = whisper.transcribe_tokens_speculative(
+        params, cfg, feats, 16
+    )
+    np.testing.assert_array_equal(vanilla, np.asarray(spec))
+    # Deterministic fixture seeds; a zero-acceptance regression needs 16.
+    assert int(passes) < 16, f"no drafts accepted ({int(passes)} passes)"
