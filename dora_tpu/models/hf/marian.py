@@ -403,3 +403,105 @@ def translate(params, cfg: MarianConfig, src_ids, max_new_tokens: int,
         length=max_new_tokens,
     )
     return tokens.T
+
+
+def translate_speculative(params, cfg: MarianConfig, src_ids,
+                          max_new_tokens: int, src_mask=None,
+                          k: int | None = None, ngram: int | None = None):
+    """Greedy translation with prompt-lookup speculation — bit-identical
+    to :func:`translate`, up to k+1 tokens per decoder pass
+    (models/spec_decode.py). Batch-1 only."""
+    from dora_tpu.models.spec_decode import (
+        SPEC_K,
+        SPEC_NGRAM,
+        check_headroom,
+    )
+
+    k = SPEC_K if k is None else k
+    ngram = SPEC_NGRAM if ngram is None else ngram
+    assert src_ids.shape[0] == 1, "speculative decode is batch-1"
+    # Context is the single decoder-start token; cache positions reach
+    # (max_new-1) + k, so the same headroom bound applies.
+    check_headroom(1, max_new_tokens, cfg.max_tokens, "decoder start", k)
+    return _translate_spec_jit(
+        params, cfg, jnp.asarray(src_ids),
+        None if src_mask is None else jnp.asarray(src_mask),
+        max_new_tokens, k, ngram,
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 4, 5, 6))
+def _translate_spec_jit(params, cfg: MarianConfig, src_ids, src_mask,
+                        max_new_tokens: int, k: int, ngram: int):
+    from dora_tpu.models import spec_decode
+
+    dtype = L.compute_dtype()
+    enc = encode(params, cfg, src_ids, src_mask=src_mask)
+    b = src_ids.shape[0]
+    cross_mask = None if src_mask is None else src_mask[:, None, None, :]
+    enc_kv = _enc_kv(params, cfg, enc)
+    scale = _embed_scale(cfg)
+    embed = params["embed"].astype(dtype)
+    caches = {
+        str(i): {
+            "k": jnp.zeros((b, cfg.heads, cfg.max_tokens, cfg.head_dim), dtype),
+            "v": jnp.zeros((b, cfg.heads, cfg.max_tokens, cfg.head_dim), dtype),
+        }
+        for i in range(cfg.dec_layers)
+    }
+
+    # Prefill: consume the decoder-start token at position 0.
+    start = jnp.full((b, 1), cfg.decoder_start_token, jnp.int32)
+    tok = embed[start] * scale
+    pos_slice = params["positions"][:1].astype(dtype)[None]
+    mask = (jnp.arange(cfg.max_tokens) <= 0)[None, None, None, :]
+    x, caches = _decoder(
+        params, cfg, tok, pos_slice, enc_kv, mask, caches, 0,
+        cross_mask=cross_mask,
+    )
+    logits = (x[:, -1] @ embed.T + params["final_logits_bias"]).astype(
+        jnp.float32
+    )
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    history = jnp.zeros((cfg.max_tokens,), jnp.int32)
+    history = history.at[0].set(cfg.decoder_start_token)
+    history = history.at[1].set(first[0])
+
+    def verify(chunk, n_emitted, caches):
+        # chunk[0, 0] is generated index n_emitted-1, consumed at decoder
+        # position n_emitted (the start token holds position 0).
+        cache_index = n_emitted
+        chunk_pos = cache_index + jnp.arange(k + 1)
+        mask = (
+            jnp.arange(cfg.max_tokens)[None, None, None, :]
+            <= chunk_pos[None, None, :, None]
+        )
+        tok = embed[chunk] * scale
+        pos_slice = jax.lax.dynamic_slice_in_dim(
+            params["positions"].astype(dtype), cache_index, k + 1
+        )[None]
+        x, new_caches = _decoder(
+            params, cfg, tok, pos_slice, enc_kv, mask, caches, cache_index,
+            cross_mask=cross_mask,
+        )
+        greedy = jnp.argmax(
+            (x[0] @ embed.T + params["final_logits_bias"]).astype(
+                jnp.float32
+            ),
+            axis=-1,
+        ).astype(jnp.int32)
+        return greedy, new_caches
+
+    tokens, passes = spec_decode.run_loop(
+        caches=caches, history=history, hist_len=2, first=first[0],
+        max_new_tokens=max_new_tokens, seq=cfg.max_tokens, verify=verify,
+        k=k, ngram=ngram,
+    )
+    if cfg.forced_eos_token is not None:
+        # transformers replaces the final emission at max length; the
+        # replaced token is never consumed, so post-hoc is equivalent.
+        tokens = tokens.at[:, max_new_tokens - 1].set(
+            jnp.int32(cfg.forced_eos_token)
+        )
+    return tokens, passes

@@ -1353,3 +1353,19 @@ def test_whisper_speculative_matches_greedy(whisper_checkpoint):
     np.testing.assert_array_equal(vanilla, np.asarray(spec))
     # Deterministic fixture seeds; a zero-acceptance regression needs 16.
     assert int(passes) < 16, f"no drafts accepted ({int(passes)} passes)"
+
+
+def test_marian_speculative_matches_greedy(marian_checkpoint):
+    """Prompt-lookup speculation on translation: bit-identical tokens to
+    vanilla greedy, fewer decoder passes."""
+    from dora_tpu.models.hf import marian
+
+    path, _, _ = marian_checkpoint
+    cfg, params = marian.load(path, max_tokens=16)
+    src = np.array([[5, 9, 23, 41, 2, 0]], np.int32)
+
+    vanilla = np.asarray(marian.translate(params, cfg, src, 10))
+    spec, passes = marian.translate_speculative(params, cfg, src, 10)
+    np.testing.assert_array_equal(vanilla, np.asarray(spec))
+    # Deterministic fixture seeds; zero acceptance would need 10 passes.
+    assert int(passes) < 10, f"no drafts accepted ({int(passes)} passes)"
