@@ -1,0 +1,13 @@
+"""Print the directory holding the bundled C/C++ headers and sources.
+
+Generated project scaffolds reference this at build time
+(``-I "$(python -m dora_tpu.cli.native_dir)"``) so a dataflow created by
+``dora-tpu new`` keeps building after the checkout moves or the package
+is installed elsewhere — the path is resolved on the machine that runs
+the build, never baked into the YAML.
+"""
+
+from dora_tpu.cli.template import _native_dir
+
+if __name__ == "__main__":
+    print(_native_dir())

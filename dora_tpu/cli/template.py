@@ -181,6 +181,13 @@ class {cls} : public dora::Operator {{
 DORA_REGISTER_OPERATOR({cls})
 '''
 
+#: ``build:`` lines run under a shell (cli/main.py), so the native/
+#: directory is resolved on the building machine via command
+#: substitution — the scaffold stays valid when the checkout moves.
+#: ``python3`` (overridable via DORA_PYTHON) rather than bare
+#: ``python``, which many distros don't ship.
+NATIVE_DIR_SH = '"$(${DORA_PYTHON:-python3} -m dora_tpu.cli.native_dir)"'
+
 C_DATAFLOW_TEMPLATE = """nodes:
   - id: source
     path: module:dora_tpu.nodehub.pyarrow_sender
@@ -217,7 +224,7 @@ NATIVE_OPERATOR_DATAFLOW_TEMPLATE = """nodes:
 
 
 def create(kind: str, name: str, path: Path, lang: str = "python") -> int:
-    native = _native_dir()
+    native = NATIVE_DIR_SH
     if kind == "node":
         path.mkdir(parents=True, exist_ok=True)
         if lang == "python":

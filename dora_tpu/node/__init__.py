@@ -180,6 +180,11 @@ class Node:
         """Next event; None when the stream ended or ``timeout`` expired."""
         return self._events.recv(timeout)
 
+    def wake(self) -> None:
+        """Unpark a parked :meth:`recv` with a ``{"type": "WAKE"}`` event
+        (thread-safe; used by the runtime's pipelined serving loop)."""
+        self._events.wake()
+
     @property
     def stream_ended(self) -> bool:
         return self._events.ended
@@ -191,10 +196,13 @@ class Node:
         return iter(self._events)
 
     def __next__(self) -> Event:
-        event = self._events.recv()
-        if event is None:
-            raise StopIteration
-        return event
+        while True:
+            event = self._events.recv()
+            if event is None:
+                raise StopIteration
+            if event is self._events.WAKE:
+                continue
+            return event
 
     # ------------------------------------------------------------------
     # outputs

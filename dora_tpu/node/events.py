@@ -122,7 +122,16 @@ class EventStream:
         if not self._eos.is_set():
             return False
         with self._queue.mutex:
-            return all(item is None for item in self._queue.queue)
+            return all(
+                item is None or item is self.WAKE
+                for item in self._queue.queue
+            )
+
+    #: Sentinel queued by :meth:`wake`; surfaces from :meth:`recv` as a
+    #: ``{"type": "WAKE"}`` event. Only the runtime's serving loop uses
+    #: wake(), and it swallows the event — plain ``for event in node``
+    #: users never see one.
+    WAKE: Event = {"type": "WAKE"}
 
     def recv(self, timeout: float | None = None) -> Event | None:
         """Next event, or None when the stream ended (or timeout expired)."""
@@ -137,11 +146,24 @@ class EventStream:
             return None
         return item
 
+    def wake(self) -> None:
+        """Unpark a ``recv(None)`` parked on an empty queue (the runtime's
+        pipelined serving loop calls this from a fetch-completion callback
+        so finished tick outputs are emitted immediately instead of being
+        polled for). Lossy by design: when the queue is full, recv is not
+        parked — the wake would be redundant."""
+        try:
+            self._queue.put_nowait(self.WAKE)
+        except queue_mod.Full:
+            pass
+
     def __iter__(self):
         while True:
             event = self.recv()
             if event is None:
                 return
+            if event is self.WAKE:
+                continue
             yield event
 
     def close(self) -> None:

@@ -182,8 +182,11 @@ def activate() -> None:
     try:
         import rclpy  # noqa: F401
 
-        if not getattr(rclpy, "__dora_tpu_loopback__", False):
-            return
+        # Idempotent: a real rclpy always wins, and a loopback that is
+        # already installed stays — rebuilding would strand existing
+        # imports on a stale module object and stack duplicate
+        # _MsgFinder entries on sys.meta_path.
+        return
     except ImportError:
         pass
     rclpy, executors = _build_rclpy_module()

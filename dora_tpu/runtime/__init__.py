@@ -182,24 +182,29 @@ def run() -> int:
     # the daemon's per-input queues, and drop-oldest applies there — a
     # camera with queue_size 1 lags the fused model by at most the few
     # in-flight events, never by an unbounded replayed backlog.
+    if fused is not None and fused.pipeline_depth > 0:
+        # Completed pipelined fetches wake the parked recv below, so the
+        # loop emits finished tick outputs immediately even when the
+        # trigger stream goes quiet — no polling interval, no idle burn.
+        fused.on_fetch_done = node.wake
+
     stop_all = False
     while True:
-        # With pipelined ticks in flight, poll instead of parking: a
-        # completed tick's output must reach downstream even when the
-        # trigger stream goes quiet (sparse/event-driven sources).
-        pending = (
-            fused is not None
-            and fused.pipeline_depth > 0
-            and fused.has_in_flight
-        )
-        event = node.recv(timeout=0.01 if pending else None)
-        if event is None:
-            if node.stream_ended:
-                break
+        event = node.recv()
+        # Emit every completed pipelined tick on EVERY iteration, not
+        # just on WAKE: a wake dropped against a full event queue (full
+        # queue == more events coming == more iterations) must not
+        # strand a finished output behind non-harvesting events.
+        if fused is not None and fused.has_in_flight:
             for outputs in fused.harvest():
                 for out_id, (arr, meta) in outputs.items():
                     node.send_output(out_id, arr, meta)
+        if event is None:
+            if node.stream_ended:
+                break
             continue
+        if event["type"] == "WAKE":
+            continue  # handled by the harvest above
         if event["type"] == "INPUT":
             op_id, _, input_id = (event["id"] or "").partition("/")
             host = python_hosts.get(op_id)
@@ -258,6 +263,8 @@ def run() -> int:
                     node.send_output(out_id, arr, meta)
         except Exception:
             logger.exception("pipelined flush failed")
+    if fused is not None:
+        fused.close()
 
     for host in python_hosts.values():
         if not host.stopped:

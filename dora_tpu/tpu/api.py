@@ -48,9 +48,13 @@ class JaxOperator:
     eagerly outside the fused jit (it may inspect values, branch on
     data, and call its own jits internally). Needed for models whose
     output shapes are data-dependent — e.g. VITS TTS, where the frame
-    count comes from predicted durations. Host operators don't fuse
-    with siblings and don't pipeline; everything else about the
-    contract (state threading, Arrow I/O) is identical.
+    count comes from predicted durations. NOTE the blast radius: one
+    host operator switches its ENTIRE node to eager execution — every
+    sibling operator fused into the same node loses jit fusion and
+    pipelining too (fusion is per-node). Put host operators in their own
+    node in the dataflow YAML to keep the fused path for the rest;
+    everything else about the contract (state threading, Arrow I/O) is
+    identical.
     """
 
     step: Callable[[Any, dict[str, Any]], tuple[Any, dict[str, Any]]]

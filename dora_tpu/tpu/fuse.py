@@ -256,10 +256,18 @@ class FusedExecutor:
 
         self.graph = graph
         self.mesh = mesh if mesh is not None else mesh_from_env()
-        #: a host operator (JaxOperator.host) opts the whole node out of
+        #: ONE host operator (JaxOperator.host) opts the WHOLE node out of
         #: tracing: its step branches on data (data-dependent output
-        #: shapes), so the graph runs eagerly and never pipelines.
+        #: shapes), so every sibling operator fused into this node also
+        #: runs eagerly and never pipelines. To keep jit+pipelining for
+        #: the rest of the graph, put host operators in their own node in
+        #: the dataflow YAML — fusion is per-node by design.
         self.eager = any(op.host for op in graph.operators.values())
+        #: optional zero-arg callback fired (from a fetch worker thread)
+        #: whenever a pipelined tick's device→host fetch completes; the
+        #: runtime points this at ``node.wake`` so its event loop parks in
+        #: ``recv(None)`` instead of polling for completed ticks.
+        self.on_fetch_done = None
         self.pipeline_depth = (
             pipeline_depth_from_env() if pipeline_depth is None
             else pipeline_depth
@@ -376,7 +384,10 @@ class FusedExecutor:
         self._compiled_once = True
         # The fetch starts NOW on its own thread; the event loop never
         # blocks in a device→host copy while the queue has headroom.
-        self._in_flight.append(self._fetch_pool.submit(self._emit, outputs))
+        future = self._fetch_pool.submit(self._emit, outputs)
+        self._in_flight.append(future)
+        if self.on_fetch_done is not None:
+            future.add_done_callback(lambda _f: self.on_fetch_done())
         if len(self._in_flight) > self.pipeline_depth:
             # Backpressure: bound in-flight ticks (and their HBM) by
             # waiting out the oldest fetch. Its result is not dropped —
@@ -402,3 +413,17 @@ class FusedExecutor:
         while self._in_flight and (block or self._in_flight[0].done()):
             done.append(self._in_flight.pop(0).result())
         return done
+
+    def close(self) -> None:
+        """Release the fetch pool. Call after the stream-end flush
+        (``harvest(block=True)``); any still-queued fetches are drained
+        so their device buffers are not abandoned mid-copy."""
+        if self._fetch_pool is not None:
+            for future in self._in_flight:
+                try:
+                    future.result()
+                except Exception:
+                    pass
+            self._in_flight.clear()
+            self._fetch_pool.shutdown(wait=True)
+            self._fetch_pool = None
