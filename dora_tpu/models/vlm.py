@@ -249,6 +249,87 @@ def decode_step(params, cfg: VLMConfig, token, caches, position):
     return logits, caches
 
 
+# ---------------------------------------------------------------------------
+# fused decode (Pallas kernel tier)
+# ---------------------------------------------------------------------------
+
+
+def fused_decode_ready(params, batch: int = 1) -> bool:
+    """True when the decode step can run the fused Pallas tier
+    (ops.decode_block): batch 1, int8-quantized fused layout from
+    quantize_decode (wqkv / w_gateup / wo / w_down / lm_head all int8
+    dicts), and no output-projection biases (Qwen2/bench layout).
+    Opt-out: DORA_FUSED_DECODE=0."""
+    import os
+
+    if os.environ.get("DORA_FUSED_DECODE", "1") in ("", "0"):
+        return False
+    if batch != 1:
+        return False
+    blocks = params.get("blocks", {})
+    blk = blocks.get("0")
+    if blk is None:
+        return False
+
+    def _q(x):
+        return isinstance(x, dict) and "int8" in x
+
+    return (
+        _q(blk.get("wqkv"))
+        and _q(blk.get("w_gateup"))
+        and _q(blk.get("wo"))
+        and _q(blk.get("w_down"))
+        and _q(params.get("lm_head"))
+        and "bo" not in blk
+        and "b_down" not in blk
+    )
+
+
+def decode_step_fused(params, cfg: VLMConfig, token, caches, position):
+    """One greedy decode step through the fused kernels: two Pallas
+    calls per layer + one for the lm_head, KV caches updated in place
+    (no logits materialize — returns the argmax token directly).
+
+    Requires :func:`fused_decode_ready`. token: [1] int32. Returns
+    (next_token [1] int32, caches).
+    """
+    from dora_tpu.ops import decode_block as DB
+
+    dtype = L.compute_dtype()
+    x = params["embed"].astype(dtype)[token]  # [1, dim]
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim)
+    cos_full, sin_signed = DB.rope_rows(cos_t, sin_t, position)
+    n_qkv = (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim
+    new_caches = {}
+    for i in range(cfg.layers):
+        blk = params["blocks"][str(i)]
+        kc = caches[str(i)]["k"][0]  # [KV, S, hd]
+        vc = caches[str(i)]["v"][0]
+        bqkv = blk.get("bqkv")
+        if bqkv is None:
+            bqkv = jnp.zeros((n_qkv,), jnp.float32)
+        x, kc, vc = DB.attention_step(
+            x, blk["attn_norm"], blk["wqkv"]["int8"], blk["wqkv"]["scale"],
+            bqkv, cos_full, sin_signed, kc, vc,
+            blk["wo"]["int8"], blk["wo"]["scale"], position,
+            heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        )
+        new_caches[str(i)] = {"k": kc[None], "v": vc[None]}
+        bgu = blk.get("b_gateup")
+        if bgu is None:
+            bgu = jnp.zeros((2 * blk["w_down"]["int8"].shape[0],), jnp.float32)
+        x = DB.mlp_step(
+            x, blk["ffn_norm"], blk["w_gateup"]["int8"],
+            blk["w_gateup"]["scale"], bgu, blk["w_down"]["int8"],
+            blk["w_down"]["scale"],
+        )
+    nxt = DB.lm_head_argmax(
+        x, params["out_norm"], params["lm_head"]["int8"],
+        params["lm_head"]["scale"],
+    )
+    return nxt, new_caches
+
+
 def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
     """Greedy generation as one traced computation (scan over decode steps).
 
@@ -258,11 +339,19 @@ def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
     logits, caches, position = prefill(params, cfg, images, prompt_ids)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    def step(carry, _):
-        token, caches, position = carry
-        logits, caches = decode_step(params, cfg, token, caches, position)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (nxt, caches, position + 1), token
+    if fused_decode_ready(params, prompt_ids.shape[0]):
+        def step(carry, _):
+            token, caches, position = carry
+            nxt, caches = decode_step_fused(
+                params, cfg, token, caches, position
+            )
+            return (nxt, caches, position + 1), token
+    else:
+        def step(carry, _):
+            token, caches, position = carry
+            logits, caches = decode_step(params, cfg, token, caches, position)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, caches, position + 1), token
 
     # Unrolling the decode scan amortizes the per-step while-loop
     # bookkeeping (batch-1 steps are sub-3ms; the loop overhead is a
@@ -341,7 +430,7 @@ def _generate_spec_jit(params, cfg: VLMConfig, images, prompt_ids,
         # (image patches + prompt precede it); `chunk[0, 0]` is
         # generated index n_emitted-1.
         cache_index = position + n_emitted - 1
-        chunk_pos = cache_index + jnp.arange(k + 1)
+        chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(cfg.max_seq)[None, None, None, :]
             <= chunk_pos[None, None, :, None]

@@ -1,0 +1,495 @@
+"""Fused batch-1 decode blocks as Pallas TPU kernels.
+
+Why: int8 vanilla decode sits at ~70% of its own HBM-bandwidth bound
+(BENCHMARKS.md). The residue is not the weight stream — it is the other
+~10 XLA ops per layer (norms, rope, cache update, attention, residuals)
+plus 4 Pallas launches per layer, each a fixed ~2.4 us entry and a break
+in DMA overlap. These kernels collapse one decode step to TWO Pallas
+calls per layer plus one for the lm_head:
+
+  ``attention_step``  — RMSNorm → fused int8 qkv matvec → RoPE →
+      in-place KV-cache row write (HBM, no full-cache copy-back) →
+      flash-decode over the *live* context (online softmax, streamed
+      from the HBM cache in blocks, trip count = position/BS + 1) →
+      int8 output projection → residual.
+  ``mlp_step``        — RMSNorm → fused int8 gate/up matvec (streamed
+      by ffn tile) → SiLU·mul → int8 down accumulation → residual,
+      one grid sweep, VMEM flat in ffn width.
+  ``lm_head_argmax``  — RMSNorm → int8 lm_head streamed by vocab tile
+      with a running argmax in SMEM — the [1, 152k] f32 logits round
+      trip to HBM and the XLA argmax disappear; the kernel returns the
+      token id.
+
+Quantization layout comes from ops.int8_matmul.quantize_tree(fuse=True):
+``wqkv``/``w_gateup`` fused int8 dicts with per-output-channel scales.
+Scales commute with the matmul (see int8_matmul), so applying them on
+the f32 accumulator is exact.
+
+Reference parity: the reference's decode path is torch/CUDA eager
+(node-hub/dora-qwenvl/dora_qwenvl/main.py) with no fused-kernel tier;
+this is the beat-on-perf axis on TPU. Non-TPU backends run the Pallas
+interpreter (tests assert parity against the plain-JAX path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def _rms(x_ref, w_ref, eps: float):
+    """f32 RMSNorm of a [M, D] ref block against weight [1, D]."""
+    x = x_ref[...].astype(jnp.float32)
+    x = x * jax.lax.rsqrt(
+        jnp.mean(x * x, axis=-1, keepdims=True) + eps
+    )
+    return x * w_ref[...].astype(jnp.float32)
+
+
+def _rotate(x, cos_full, sin_signed, half: int):
+    """NeoX rotary on [H, hd] rows given full-width tables:
+    ``cos_full = [cos, cos]``, ``sin_signed = [-sin, sin]`` — then
+    ``x*cos_full + swap_halves(x)*sin_signed`` is exactly
+    ``[x1*cos - x2*sin, x2*cos + x1*sin]``."""
+    swapped = jnp.concatenate([x[:, half:], x[:, :half]], axis=1)
+    return x * cos_full + swapped * sin_signed
+
+
+# ---------------------------------------------------------------------------
+# attention block
+# ---------------------------------------------------------------------------
+
+
+def _attn_kernel(
+    pos_ref,  # SMEM (1,) int32 — scalar prefetch
+    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+    kc_in, vc_in, wo_ref, swo_ref,
+    out_ref, kc_out, vc_out,
+    kv_row, kblk, vblk, sem,
+    *, heads: int, kv_heads: int, head_dim: int, bs: int, eps: float,
+):
+    pos = pos_ref[0]
+    half = head_dim // 2
+    dtype = x_ref.dtype
+
+    # --- projections --------------------------------------------------------
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [1, D]
+    qkv = jax.lax.dot(
+        h, wqkv_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    )  # [1, (H+2KV)*hd]
+    qkv = qkv * sqkv_ref[...].astype(jnp.float32) + bqkv_ref[...].astype(
+        jnp.float32
+    )
+    qkv = qkv.reshape(heads + 2 * kv_heads, head_dim)
+    q = qkv[:heads]
+    k = qkv[heads : heads + kv_heads]
+    v = qkv[heads + kv_heads :]
+
+    cos_full = cos_ref[...].astype(jnp.float32)  # [1, hd]
+    sin_signed = sin_ref[...].astype(jnp.float32)
+    q = _rotate(q, cos_full, sin_signed, half)
+    k = _rotate(k, cos_full, sin_signed, half)
+
+    # --- in-place cache row write (overlapped) ------------------------------
+    # DMA slices must be sublane-aligned (8), so the write is an aligned
+    # 8-row read-modify-write: pull the row group, select-insert the new
+    # row (no sub-tile dynamic indexing anywhere), push it back. The
+    # attention below never reads position ``pos`` from the cache — the
+    # fresh k/v fold in from registers — so only the RMW *read* gates
+    # the insert; the write-back overlaps the whole attention sweep and
+    # is awaited at kernel end.
+    aligned = pl.multiple_of(pos // 8 * 8, 8)
+    row_sel = (
+        jax.lax.broadcasted_iota(jnp.int32, (kv_heads, 8, head_dim), 1)
+        == pos - aligned
+    )
+    krd = pltpu.make_async_copy(
+        kc_out.at[:, pl.ds(aligned, 8), :], kv_row.at[0], sem.at[0]
+    )
+    vrd = pltpu.make_async_copy(
+        vc_out.at[:, pl.ds(aligned, 8), :], kv_row.at[1], sem.at[1]
+    )
+    krd.start()
+    vrd.start()
+    krd.wait()
+    vrd.wait()
+    kv_row[0] = jnp.where(
+        row_sel, k[:, None, :].astype(kv_row.dtype), kv_row[0]
+    )
+    kv_row[1] = jnp.where(
+        row_sel, v[:, None, :].astype(kv_row.dtype), kv_row[1]
+    )
+    kwr = pltpu.make_async_copy(
+        kv_row.at[0], kc_out.at[:, pl.ds(aligned, 8), :], sem.at[0]
+    )
+    vwr = pltpu.make_async_copy(
+        kv_row.at[1], vc_out.at[:, pl.ds(aligned, 8), :], sem.at[1]
+    )
+    kwr.start()
+    vwr.start()
+
+    # --- flash-decode over the PRIOR context (idx < pos) --------------------
+    # Streams K/V HBM blocks; online softmax so VMEM is flat in context.
+    # The row being written this step is excluded from the sweep (its
+    # contribution folds in from registers below), which is what lets
+    # the write-back stay off the critical path. NOTE: blocks past
+    # ``aligned`` may transiently hold the half-written row group, but
+    # that row is masked out by ``live``.
+    group = heads // kv_heads
+    scale = 1.0 / (head_dim ** 0.5)
+    nblocks = (pos + bs - 1) // bs  # ceil(pos / bs): prior context only
+
+    def body(b, carry):
+        m_run, l_run, acc = carry
+        kcp = pltpu.make_async_copy(
+            kc_out.at[:, pl.ds(b * bs, bs), :], kblk, sem.at[2]
+        )
+        vcp = pltpu.make_async_copy(
+            vc_out.at[:, pl.ds(b * bs, bs), :], vblk, sem.at[3]
+        )
+        kcp.start()
+        vcp.start()
+        kcp.wait()
+        vcp.wait()
+        live = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1) + b * bs
+        ) < pos  # [1, bs] — strictly prior positions
+        scores = []
+        for g in range(kv_heads):
+            s_g = jax.lax.dot_general(
+                q[g * group : (g + 1) * group].astype(dtype),
+                kblk[g].astype(dtype),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [group, bs]
+            scores.append(s_g)
+        s = jnp.concatenate(scores, axis=0) * scale  # [H, bs]
+        s = jnp.where(live, s, -jnp.inf)
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)  # [H, bs]
+        l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = []
+        for g in range(kv_heads):
+            pv_g = jax.lax.dot(
+                p[g * group : (g + 1) * group].astype(dtype),
+                vblk[g].astype(dtype),
+                preferred_element_type=jnp.float32,
+            )  # [group, hd]
+            pv.append(pv_g)
+        acc_new = acc * alpha + jnp.concatenate(pv, axis=0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((heads, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((heads, 1), jnp.float32)
+    a0 = jnp.zeros((heads, head_dim), jnp.float32)
+    m_fin, l_fin, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, a0))
+
+    # Fold in the current position from registers (exact: one more
+    # online-softmax merge; when nblocks == 0 the exp(-inf - s) terms
+    # vanish and attention degenerates to v, as it must at pos == 0).
+    q3 = q.reshape(kv_heads, group, head_dim)
+    s_new = (
+        jnp.sum(q3 * k[:, None, :], axis=-1).reshape(heads, 1) * scale
+    )  # [H, 1], f32
+    m2 = jnp.maximum(m_fin, s_new)
+    alpha = jnp.exp(m_fin - m2)
+    w_new = jnp.exp(s_new - m2)  # [H, 1]
+    l2 = l_fin * alpha + w_new
+    v_full = jnp.broadcast_to(
+        v[:, None, :], (kv_heads, group, head_dim)
+    ).reshape(heads, head_dim)
+    attn = (acc * alpha + w_new * v_full) / l2  # [H, hd]
+
+    # --- output projection + residual ---------------------------------------
+    o = jax.lax.dot(
+        attn.reshape(1, heads * head_dim).astype(dtype),
+        wo_ref[...].astype(dtype),
+        preferred_element_type=jnp.float32,
+    ) * swo_ref[...].astype(jnp.float32)
+    out_ref[...] = (x_ref[...].astype(jnp.float32) + o).astype(out_ref.dtype)
+    kwr.wait()
+    vwr.wait()
+
+
+@functools.partial(
+    jax.jit, static_argnames=("heads", "kv_heads", "head_dim", "eps")
+)
+def attention_step(
+    x, norm_w, wqkv, sqkv, bqkv, cos_full, sin_signed, k_cache, v_cache,
+    wo, swo, position, *, heads: int, kv_heads: int, head_dim: int,
+    eps: float = 1e-6,
+):
+    """One fused decode attention sublayer.
+
+    x: [1, D]; wqkv int8 [D, (H+2KV)*hd] with scale [1, ...]; caches
+    [KV, S, hd] (updated in place at ``position`` — the returned caches
+    alias the inputs); cos_full/sin_signed: [1, hd] position-gathered
+    rope rows (see vlm rope prep). Returns (x_out, k_cache, v_cache).
+    """
+    seq = k_cache.shape[1]
+    bs = min(512, seq)
+    assert seq % bs == 0, (seq, bs)
+    d = x.shape[-1]
+    n_qkv = wqkv.shape[1]
+    kernel = functools.partial(
+        _attn_kernel, heads=heads, kv_heads=kv_heads, head_dim=head_dim,
+        bs=bs, eps=eps,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # norm_w
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # cos
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sin
+            pl.BlockSpec(memory_space=pl.ANY),   # k_cache (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),   # v_cache (HBM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x_out
+            pl.BlockSpec(memory_space=pl.ANY),   # k_cache
+            pl.BlockSpec(memory_space=pl.ANY),   # v_cache
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, kv_heads, 8, head_dim), k_cache.dtype),  # kv_row
+            pltpu.VMEM((kv_heads, bs, head_dim), k_cache.dtype),  # kblk
+            pltpu.VMEM((kv_heads, bs, head_dim), v_cache.dtype),  # vblk
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, d), x.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        # positional arg i (0-based, INCLUDING the scalar prefetch) ->
+        # output j: the caches update in place, no copy-back.
+        input_output_aliases={8: 1, 9: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        jnp.asarray([position], jnp.int32).reshape(1),
+        x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
+        cos_full, sin_signed, k_cache, v_cache, wo, swo,
+    )
+
+
+# ---------------------------------------------------------------------------
+# MLP block
+# ---------------------------------------------------------------------------
+
+
+def _mlp_kernel(
+    x_ref, nw_ref, gate_ref, up_ref, sg_ref, su_ref, bg_ref, bu_ref,
+    down_ref, sd_ref, out_ref, acc_ref, *, nf: int, eps: float,
+):
+    fi = pl.program_id(0)
+    dtype = x_ref.dtype
+
+    @pl.when(fi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # recomputed per tile: O(D)
+    g = jax.lax.dot(
+        h, gate_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    ) * sg_ref[...].astype(jnp.float32) + bg_ref[...].astype(jnp.float32)
+    u = jax.lax.dot(
+        h, up_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    ) * su_ref[...].astype(jnp.float32) + bu_ref[...].astype(jnp.float32)
+    a = (jax.nn.silu(g) * u).astype(dtype)  # [1, BF]
+    acc_ref[...] += jax.lax.dot(
+        a, down_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(fi == nf - 1)
+    def _finalize():
+        out_ref[...] = (
+            x_ref[...].astype(jnp.float32)
+            + acc_ref[...] * sd_ref[...].astype(jnp.float32)
+        ).astype(out_ref.dtype)
+
+
+def _pick_bf(ffn: int) -> int:
+    """Largest lane-multiple tile <= 1024 dividing ffn. The cap keeps
+    the three per-step int8 panels (gate + up + down ~ 3*D*BF bytes)
+    under half of VMEM so Mosaic can double-buffer the stream — a
+    bigger tile serializes the DMAs and shows up directly as decode
+    latency (measured: 1792 -> 896 on the 2B shape was worth ~5%)."""
+    if ffn % _LANE:
+        return ffn
+    for bf in range(min(ffn, 1024), 0, -_LANE):
+        if ffn % bf == 0:
+            return bf
+    return ffn
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
+             *, eps: float = 1e-6):
+    """Fused SwiGLU decode sublayer: one grid sweep over ffn tiles.
+
+    w_gateup: int8 [D, 2F] (gate | up concatenated — quantize_tree
+    layout); w_down: int8 [F, D]. Returns x + down(silu(gate)·up).
+    """
+    d = x.shape[-1]
+    f = w_down.shape[0]
+    bf = _pick_bf(f)
+    nf = f // bf
+    kernel = functools.partial(_mlp_kernel, nf=nf, eps=eps)
+    return pl.pallas_call(
+        kernel,
+        grid=(nf,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i: (0, 0)),          # x
+            pl.BlockSpec((1, d), lambda i: (0, 0)),          # norm_w
+            pl.BlockSpec((d, bf), lambda i: (0, i)),          # gate tile
+            pl.BlockSpec((d, bf), lambda i, _nf=nf: (0, _nf + i)),  # up tile
+            pl.BlockSpec((1, bf), lambda i: (0, i)),          # gate scale
+            pl.BlockSpec((1, bf), lambda i, _nf=nf: (0, _nf + i)),  # up scale
+            pl.BlockSpec((1, bf), lambda i: (0, i)),          # gate bias
+            pl.BlockSpec((1, bf), lambda i, _nf=nf: (0, _nf + i)),  # up bias
+            pl.BlockSpec((bf, d), lambda i: (i, 0)),          # down tile
+            pl.BlockSpec((1, d), lambda i: (0, 0)),           # down scale
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        # gate and up tiles index into the same fused arrays (two specs
+        # with different index maps), so each rides in twice.
+        x, norm_w.reshape(1, d), w_gateup, w_gateup, s_gateup, s_gateup,
+        b_gateup.reshape(1, 2 * f), b_gateup.reshape(1, 2 * f),
+        w_down, s_down,
+    )
+
+
+# ---------------------------------------------------------------------------
+# lm_head + argmax
+# ---------------------------------------------------------------------------
+
+
+def _head_kernel(
+    x_ref, nw_ref, w_ref, s_ref, out_ref, best_ref, besti_ref,
+    *, nv: int, bv: int, vocab: int, eps: float,
+):
+    vi = pl.program_id(0)
+    dtype = x_ref.dtype
+    m = x_ref.shape[0]
+
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)
+    logits = jax.lax.dot(
+        h, w_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    ) * s_ref[...].astype(jnp.float32)  # [M, BV]
+    # Padded vocab tail (if any) must never win.
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + vi * bv
+    logits = jnp.where(col < vocab, logits, -jnp.inf)
+    blk_max = jnp.max(logits, axis=-1)  # [M]
+    blk_arg = jnp.argmax(logits, axis=-1).astype(jnp.int32) + vi * bv
+
+    @pl.when(vi == 0)
+    def _init():
+        best_ref[...] = jnp.full_like(best_ref, -jnp.inf)
+        besti_ref[...] = jnp.zeros_like(besti_ref)
+
+    # Strict > keeps the first-index tie-break of jnp.argmax across
+    # blocks; within a block argmax already takes the first maximum.
+    better = blk_max > best_ref[...][:, 0]
+    best_ref[...] = jnp.where(better, blk_max, best_ref[...][:, 0])[:, None]
+    besti_ref[...] = jnp.where(better, blk_arg, besti_ref[...][:, 0])[:, None]
+
+    @pl.when(vi == nv - 1)
+    def _finalize():
+        out_ref[...] = besti_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
+    """Greedy next-token ids straight from the kernel.
+
+    x: [M, D] (M = 1 vanilla decode, k+1 speculative verify); w: int8
+    [D, V]. Streams the head by vocab tile with a running per-row
+    argmax — no [M, V] f32 logits materialize anywhere. Returns [M]
+    int32.
+    """
+    import os
+
+    m, d = x.shape
+    vocab = w.shape[1]
+    # Tile sweep note (v5e, 152k vocab): 2048 keeps the int8 panel +
+    # its in-register bf16 conversion inside the double-buffer budget;
+    # 4096 measured ~2x slower end-to-end (VMEM pressure serializes the
+    # stream). Override for experiments via DORA_HEAD_BV.
+    bv = int(os.environ.get("DORA_HEAD_BV", "2048"))
+    if vocab % bv:
+        pad = bv - vocab % bv
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+        s = jnp.pad(s, ((0, 0), (0, pad)))
+    nv = w.shape[1] // bv
+    kernel = functools.partial(
+        _head_kernel, nv=nv, bv=bv, vocab=vocab, eps=eps
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(nv,),
+        in_specs=[
+            pl.BlockSpec((m, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, bv), lambda i: (0, i)),
+            pl.BlockSpec((1, bv), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((m, 1), jnp.float32),
+            pltpu.VMEM((m, 1), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(x, norm_w.reshape(1, d), w, s)
+    return out[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# rope row prep (shared by the fused step)
+# ---------------------------------------------------------------------------
+
+
+def rope_rows(cos_table, sin_table, position):
+    """Gather the rope row at ``position`` and expand to the kernel's
+    full-width layout: cos_full = [cos, cos], sin_signed = [-sin, sin]
+    (see _rotate). Tables: [S, hd/2]. Returns two [1, hd] f32 rows."""
+    cos = jax.lax.dynamic_slice_in_dim(cos_table, position, 1, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_table, position, 1, 0)
+    return (
+        jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32),
+        jnp.concatenate([-sin, sin], axis=-1).astype(jnp.float32),
+    )

@@ -299,3 +299,120 @@ def test_vlm_int8_decode_logits_close():
     # and the full generate path runs end to end on quantized weights
     tokens = vlm.generate(qparams, cfg, image, prompt, 4)
     assert tokens.shape == (1, 4)
+
+
+# ---------------------------------------------------------------------------
+# fused decode kernels (ops.decode_block)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pos", [0, 5, 37])
+def test_decode_attention_step_matches_dense(pos):
+    """attention_step (norm + int8 qkv + rope + in-place cache write +
+    flash-decode + int8 wo + residual) matches the plain-JAX sublayer."""
+    from dora_tpu.ops.decode_block import attention_step, rope_rows
+    from dora_tpu.ops.int8_matmul import dequantize, quantize_int8
+
+    rng = np.random.default_rng(pos)
+    D, H, KV, HD, S = 64, 4, 2, 16, 64
+    x = jnp.asarray(rng.standard_normal((1, D)), jnp.float32)
+    nw = jnp.asarray(rng.standard_normal(D), jnp.float32)
+    wqkv = quantize_int8(
+        jnp.asarray(rng.standard_normal((D, (H + 2 * KV) * HD)), jnp.float32)
+    )
+    wo = quantize_int8(jnp.asarray(rng.standard_normal((H * HD, D)), jnp.float32))
+    bqkv = jnp.asarray(rng.standard_normal((H + 2 * KV) * HD), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((KV, S, HD)), jnp.float32) * 0.1
+    vc = jnp.asarray(rng.standard_normal((KV, S, HD)), jnp.float32) * 0.1
+    cos_t, sin_t = L.rope_table(S, HD)
+    cos_full, sin_signed = rope_rows(cos_t, sin_t, pos)
+
+    xo, kc2, vc2 = attention_step(
+        x, nw, wqkv["int8"], wqkv["scale"], bqkv, cos_full, sin_signed,
+        kc, vc, wo["int8"], wo["scale"], pos,
+        heads=H, kv_heads=KV, head_dim=HD,
+    )
+
+    h = L.rms_norm(x, nw)
+    qkv = h @ dequantize(wqkv) + bqkv
+    q, k, v = jnp.split(qkv, [H * HD, (H + KV) * HD], axis=-1)
+    q = q.reshape(1, 1, H, HD).transpose(0, 2, 1, 3)
+    k = k.reshape(1, 1, KV, HD).transpose(0, 2, 1, 3)
+    v = v.reshape(1, 1, KV, HD).transpose(0, 2, 1, 3)
+    posarr = jnp.broadcast_to(jnp.asarray(pos), (1, 1))
+    q = L.apply_rope(q, cos_t, sin_t, posarr)
+    k = L.apply_rope(k, cos_t, sin_t, posarr)
+    kfull = jax.lax.dynamic_update_slice(kc[None], k, (0, 0, pos, 0))
+    vfull = jax.lax.dynamic_update_slice(vc[None], v, (0, 0, pos, 0))
+    kr = jnp.repeat(kfull, H // KV, axis=1)
+    vr = jnp.repeat(vfull, H // KV, axis=1)
+    mask = (jnp.arange(S) <= pos)[None, None, None, :]
+    out = L.attention(q, kr, vr, mask)
+    out = out.transpose(0, 2, 1, 3).reshape(1, H * HD)
+    ref = x + out @ dequantize(wo)
+
+    np.testing.assert_allclose(np.asarray(xo), np.asarray(ref), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(kc2), np.asarray(kfull[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vc2), np.asarray(vfull[0]), atol=1e-5)
+
+
+def test_decode_mlp_step_matches_dense():
+    from dora_tpu.ops.decode_block import mlp_step
+    from dora_tpu.ops.int8_matmul import dequantize, quantize_int8
+
+    rng = np.random.default_rng(1)
+    D, F = 64, 256
+    x = jnp.asarray(rng.standard_normal((1, D)), jnp.float32)
+    nw = jnp.asarray(rng.standard_normal(D), jnp.float32)
+    wgu = quantize_int8(jnp.asarray(rng.standard_normal((D, 2 * F)), jnp.float32))
+    wd = quantize_int8(jnp.asarray(rng.standard_normal((F, D)), jnp.float32))
+    bgu = jnp.asarray(rng.standard_normal(2 * F), jnp.float32)
+
+    out = mlp_step(
+        x, nw, wgu["int8"], wgu["scale"], bgu, wd["int8"], wd["scale"]
+    )
+
+    h = L.rms_norm(x, nw)
+    gu = h @ dequantize(wgu) + bgu
+    g, u = jnp.split(gu, 2, axis=-1)
+    ref = x + (jax.nn.silu(g) * u) @ dequantize(wd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
+
+
+@pytest.mark.parametrize("m,vocab", [(1, 256), (5, 300)])
+def test_decode_lm_head_argmax(m, vocab):
+    """Streamed argmax (incl. non-multiple vocab padding and M>1 rows for
+    speculative verify) matches argmax over the dense logits."""
+    from dora_tpu.ops.decode_block import lm_head_argmax
+    from dora_tpu.ops.int8_matmul import dequantize, quantize_int8
+
+    rng = np.random.default_rng(m * 1000 + vocab)
+    D = 64
+    x = jnp.asarray(rng.standard_normal((m, D)), jnp.float32)
+    nw = jnp.asarray(rng.standard_normal(D), jnp.float32)
+    wh = quantize_int8(jnp.asarray(rng.standard_normal((D, vocab)), jnp.float32))
+
+    tok = lm_head_argmax(x, nw, wh["int8"], wh["scale"])
+    ref = jnp.argmax(L.rms_norm(x, nw) @ dequantize(wh), axis=-1)
+    np.testing.assert_array_equal(np.asarray(tok), np.asarray(ref))
+
+
+def test_fused_decode_generate_matches_vanilla(monkeypatch):
+    """vlm.generate through the fused Pallas decode tier emits the same
+    tokens as the unfused int8 path on the same quantized weights."""
+    from dora_tpu.models import vlm
+
+    cfg = vlm.VLMConfig.tiny()
+    params = vlm.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = vlm.quantize_decode(params)
+    assert vlm.fused_decode_ready(qparams)
+    image = jax.random.uniform(
+        jax.random.PRNGKey(1), (1, cfg.image_size, cfg.image_size, 3)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0, cfg.vocab)
+
+    monkeypatch.setenv("DORA_FUSED_DECODE", "0")
+    vanilla = np.asarray(vlm.generate(qparams, cfg, image, prompt, 8))
+    monkeypatch.setenv("DORA_FUSED_DECODE", "1")
+    fused = np.asarray(vlm.generate(qparams, cfg, image, prompt, 8))
+    np.testing.assert_array_equal(vanilla, fused)
