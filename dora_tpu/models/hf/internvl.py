@@ -421,7 +421,7 @@ def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
         # both cache and rotary; chunk[0, 0] is generated index
         # n_emitted-1.
         cache_index = t + n_emitted - 1
-        chunk_pos = cache_index + jnp.arange(k + 1)
+        chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(tc.max_seq)[None, None, None, :]
             <= chunk_pos[None, None, :, None]

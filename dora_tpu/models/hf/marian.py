@@ -472,14 +472,14 @@ def _translate_spec_jit(params, cfg: MarianConfig, src_ids, src_mask,
         # chunk[0, 0] is generated index n_emitted-1, consumed at decoder
         # position n_emitted (the start token holds position 0).
         cache_index = n_emitted
-        chunk_pos = cache_index + jnp.arange(k + 1)
+        chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(cfg.max_tokens)[None, None, None, :]
             <= chunk_pos[None, None, :, None]
         )
         tok = embed[chunk] * scale
         pos_slice = jax.lax.dynamic_slice_in_dim(
-            params["positions"].astype(dtype), cache_index, k + 1
+            params["positions"].astype(dtype), cache_index, chunk.shape[1]
         )[None]
         x, new_caches = _decoder(
             params, cfg, tok, pos_slice, enc_kv, mask, caches, cache_index,

@@ -520,12 +520,13 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
         # M-RoPE position delta + j (text continuation advances all
         # three axes together); chunk[0, 0] is generated index
         # n_emitted-1.
+        w = chunk.shape[1]  # k+1, or 1 for an adaptive plain pass
         gen_idx = n_emitted - 1
         cache_index = t + gen_idx
-        rope_pos = delta[0] + gen_idx + jnp.arange(k + 1)
-        pos3 = jnp.broadcast_to(rope_pos[None, None], (3, 1, k + 1))
+        rope_pos = delta[0] + gen_idx + jnp.arange(w)
+        pos3 = jnp.broadcast_to(rope_pos[None, None], (3, 1, w))
         ccos, csin = _mrope_tables(cfg, pos3)
-        cache_pos = cache_index + jnp.arange(k + 1)
+        cache_pos = cache_index + jnp.arange(w)
         mask = (
             jnp.arange(cfg.max_seq)[None, None, None, :]
             <= cache_pos[None, None, :, None]

@@ -444,7 +444,7 @@ def _transcribe_spec_jit(params, cfg: WhisperConfig, input_features,
         # positions index the same way); chunk[0, 0] is generated index
         # n_emitted-1.
         cache_index = f + n_emitted - 1
-        chunk_pos = cache_index + jnp.arange(k + 1)
+        chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(cfg.max_target)[None, None, None, :]
             <= chunk_pos[None, None, :, None]

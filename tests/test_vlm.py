@@ -171,3 +171,78 @@ def test_speculative_decode_context_guard():
     prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 10), 0, cfg.vocab)
     with pytest.raises(ValueError, match="speculation headroom"):
         vlm.generate_speculative(params, cfg, image, prompt, 40)
+
+
+# ---------------------------------------------------------------------------
+# adaptive speculation (round 4)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_loop(expected, max_new, seq=128, adaptive=True):
+    """Drive spec_decode.run_loop with a position-deterministic fake
+    model: generated token j is expected[j] regardless of drafts."""
+    import jax.numpy as jnp
+
+    from dora_tpu.models import spec_decode
+
+    exp_arr = jnp.asarray(expected, jnp.int32)
+
+    def verify_fixed(chunk, n_emitted, caches):
+        # greedy[i] continues the prefix ending at chunk[0, i], which is
+        # generated index n_emitted-1+i => next token expected[n_emitted+i].
+        idx = n_emitted + jnp.arange(chunk.shape[1])
+        return exp_arr[idx], caches
+
+    history = jnp.zeros((seq,), jnp.int32)
+    prompt = jnp.asarray([7, 11, 13], jnp.int32)
+    history = history.at[:3].set(prompt)
+    history = history.at[3].set(exp_arr[0])
+
+    @jax.jit
+    def run():
+        return spec_decode.run_loop(
+            caches={}, history=history, hist_len=4, first=exp_arr[0],
+            max_new_tokens=max_new, seq=seq, verify=verify_fixed,
+            adaptive=adaptive, return_stats=True,
+        )
+
+    tokens, passes, spec_passes = run()
+    return np.asarray(tokens)[0], int(passes), int(spec_passes)
+
+
+def test_spec_adaptive_adversarial_backs_off():
+    """A non-repetitive stream (prompt lookup never matches) must fall
+    back to single-token passes: output stays exact, and only a bounded
+    fraction of passes pay the full-chunk verification cost."""
+    max_new = 60
+    expected = [(17 * j + 5) % 251 for j in range(max_new + 10)]
+    tokens, passes, spec_passes = _synthetic_loop(expected, max_new)
+    np.testing.assert_array_equal(tokens, expected[:max_new])
+    # every pass emits >= 1 token; adversarial acceptance means ~1 each
+    assert passes >= max_new * 0.9
+    # the adaptive gate caps full-chunk probes well below half the passes
+    assert spec_passes <= passes * 0.35, (spec_passes, passes)
+
+
+def test_spec_adaptive_stays_on_for_repetitive():
+    """A cyclic stream keeps acceptance high: the loop stays in chunk
+    mode and needs far fewer passes than tokens."""
+    max_new = 60
+    expected = [(3, 9, 27)[j % 3] for j in range(max_new + 10)]
+    tokens, passes, spec_passes = _synthetic_loop(expected, max_new)
+    np.testing.assert_array_equal(tokens, expected[:max_new])
+    assert passes <= max_new // 2, passes
+    # dominated by full-chunk passes once the lookup window fills (the
+    # first cycle repetition); only the warm-up may run plain
+    assert spec_passes >= (passes - 1) * 0.7, (spec_passes, passes)
+
+
+def test_spec_non_adaptive_always_chunks():
+    max_new = 30
+    expected = [(17 * j + 5) % 251 for j in range(max_new + 10)]
+    tokens, passes, spec_passes = _synthetic_loop(
+        expected, max_new, adaptive=False
+    )
+    np.testing.assert_array_equal(tokens, expected[:max_new])
+    # `passes` starts at 1 (the prefill argmax); every loop pass chunks
+    assert spec_passes == passes - 1
