@@ -24,8 +24,10 @@ path (the self-contained trainable stack lives in models/tts.py).
 
 Shape note: text length and output frame count are data-dependent, so
 synthesis runs as three jits (encode, duration, decode) with the
-expansion matrix built host-side — serve with length bucketing to bound
-recompiles on TPU.
+expansion matrix built host-side. Serving uses ``synthesize_bucketed``:
+inputs pad to bucket edges with the true length threaded through masked
+graphs, so compilation count is bounded by the bucket grid (the TTS
+operator in nodehub/ops.py routes through it).
 """
 
 from __future__ import annotations
@@ -332,6 +334,13 @@ def conv_transpose1d(x, p: dict, *, stride, padding):
     return out
 
 
+def _length_mask(b: int, t: int, length, dtype):
+    """[B, 1, T] {0,1} mask of real positions (< ``length``). ``length``
+    is a traced scalar so one compilation serves a whole bucket."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, (b, 1, t), 2)
+    return (idx < length).astype(dtype)
+
+
 def _ln_channels(x, w, b, eps):
     """LayerNorm over the channel dim of [B, C, T]."""
     mean = jnp.mean(x, axis=1, keepdims=True)
@@ -379,7 +388,7 @@ def _absolute_to_relative(x):
     return x.reshape(bh, length, 2 * length)[:, :, 1:]
 
 
-def _encoder_attention(block, x, cfg: VitsConfig):
+def _encoder_attention(block, x, cfg: VitsConfig, key_mask=None):
     b, t, _ = x.shape
     h, hd = cfg.heads, cfg.head_dim
     scale = hd**-0.5
@@ -393,6 +402,9 @@ def _encoder_attention(block, x, cfg: VitsConfig):
     weights = q @ k.transpose(0, 2, 1)  # [BH, T, T]
     rel_k = _relative_embeddings(block["rel_k"], t, cfg.window_size)
     weights = weights + _relative_to_absolute(q @ rel_k.T)
+    if key_mask is not None:  # [B, 1, T] — bucketed padding never attends
+        km = jnp.repeat(key_mask > 0, h, axis=0)  # [BH, 1, T]
+        weights = jnp.where(km, weights, jnp.finfo(weights.dtype).min)
     probs = jax.nn.softmax(weights, axis=-1)
     out = probs @ v
     rel_v = _relative_embeddings(block["rel_v"], t, cfg.window_size)
@@ -401,35 +413,54 @@ def _encoder_attention(block, x, cfg: VitsConfig):
     return out @ block["wo"] + block["bo"]
 
 
-def _encoder_ffn(block, x, cfg: VitsConfig):
+def _encoder_ffn(block, x, cfg: VitsConfig, mask=None):
     h = x.transpose(0, 2, 1)  # [B, C, T]
+    if mask is not None:
+        h = h * mask
     pad_l = (cfg.ffn_kernel - 1) // 2
     pad_r = cfg.ffn_kernel // 2
     h = jnp.pad(h, ((0, 0), (0, 0), (pad_l, pad_r)))
     h = jax.nn.relu(conv1d(h, block["fc1"]))
+    if mask is not None:  # bias re-fills padding; zero it before fc2 reads
+        h = h * mask
     h = jnp.pad(h, ((0, 0), (0, 0), (pad_l, pad_r)))
     h = conv1d(h, block["fc2"])
     return h.transpose(0, 2, 1)
 
 
 @partial(jax.jit, static_argnums=(1,))
-def encode_text(params, cfg: VitsConfig, input_ids):
+def encode_text(params, cfg: VitsConfig, input_ids, length=None):
     """input_ids [B, T] -> (hidden [B, dim, T], prior_means [B, T, flow],
-    prior_log_var [B, T, flow])."""
+    prior_log_var [B, T, flow]).
+
+    ``length`` (traced scalar) marks the real prefix of a
+    bucket-padded batch: padding is masked out of attention and zeroed
+    around every conv, so the real positions compute exactly what an
+    unpadded run computes (see synthesize_bucketed).
+    """
+    b, t = input_ids.shape
+    mask = None if length is None else _length_mask(
+        b, t, length, params["embed"].dtype
+    )
     x = params["embed"][input_ids] * math.sqrt(cfg.dim)  # [B, T, dim]
+    if mask is not None:
+        x = x * mask.transpose(0, 2, 1)
     for i in range(cfg.layers):
         block = params["enc_blocks"][str(i)]
         x = _ln_last(
-            x + _encoder_attention(block, x, cfg), block["ln1"],
-            block["ln1_b"], cfg.norm_eps,
+            x + _encoder_attention(block, x, cfg, key_mask=mask),
+            block["ln1"], block["ln1_b"], cfg.norm_eps,
         )
         x = _ln_last(
-            x + _encoder_ffn(block, x, cfg), block["ln2"], block["ln2_b"],
-            cfg.norm_eps,
+            x + _encoder_ffn(block, x, cfg, mask=mask), block["ln2"],
+            block["ln2_b"], cfg.norm_eps,
         )
-    stats = conv1d(x.transpose(0, 2, 1), params["project"]).transpose(0, 2, 1)
+    h = x.transpose(0, 2, 1)
+    if mask is not None:
+        h = h * mask
+    stats = conv1d(h, params["project"]).transpose(0, 2, 1)
     means, log_var = jnp.split(stats, 2, axis=-1)
-    return x.transpose(0, 2, 1), means, log_var
+    return h, means, log_var
 
 
 # ---------------------------------------------------------------------------
@@ -437,7 +468,7 @@ def encode_text(params, cfg: VitsConfig, input_ids):
 # ---------------------------------------------------------------------------
 
 
-def _dds_forward(dds_params, x, cfg: VitsConfig, cond=None):
+def _dds_forward(dds_params, x, cfg: VitsConfig, cond=None, mask=None):
     if cond is not None:
         x = x + cond
     k = cfg.duration_kernel
@@ -445,6 +476,8 @@ def _dds_forward(dds_params, x, cfg: VitsConfig, cond=None):
         layer = dds_params[str(i)]
         dilation = k**i
         padding = (k * dilation - dilation) // 2
+        if mask is not None:  # keep padding zero under the dilated taps
+            x = x * mask
         h = conv1d(x, layer["dilated"], dilation=dilation, padding=padding,
                    groups=cfg.dim)
         h = _ln_channels(h, layer["norm1"], layer["norm1_b"], cfg.norm_eps)
@@ -453,6 +486,8 @@ def _dds_forward(dds_params, x, cfg: VitsConfig, cond=None):
         h = _ln_channels(h, layer["norm2"], layer["norm2_b"], cfg.norm_eps)
         h = jax.nn.gelu(h, approximate=False)
         x = x + h
+    if mask is not None:
+        x = x * mask
     return x
 
 
@@ -518,11 +553,11 @@ def _spline_inverse(inputs, uw, uh, ud, cfg: VitsConfig):
     return jnp.where(inside, out, inputs)
 
 
-def _conv_flow_reverse(flow, x, cfg: VitsConfig, cond):
+def _conv_flow_reverse(flow, x, cfg: VitsConfig, cond, mask=None):
     half = cfg.depth_separable_channels // 2
     first, second = x[:, :half], x[:, half:]
     h = conv1d(first, flow["conv_pre"])
-    h = _dds_forward(flow["dds"], h, cfg, cond=cond)
+    h = _dds_forward(flow["dds"], h, cfg, cond=cond, mask=mask)
     h = conv1d(h, flow["conv_proj"])
     b, _, t = first.shape
     h = h.reshape(b, half, -1, t).transpose(0, 1, 3, 2)  # [B, half, T, 3bins-1]
@@ -536,14 +571,23 @@ def _conv_flow_reverse(flow, x, cfg: VitsConfig, cond):
 
 
 @partial(jax.jit, static_argnums=(1,), static_argnames=("noise_scale",))
-def predict_log_duration(params, cfg: VitsConfig, hidden, noise_scale=None):
-    """hidden [B, dim, T] -> log durations [B, 1, T]."""
+def predict_log_duration(params, cfg: VitsConfig, hidden, noise_scale=None,
+                         length=None):
+    """hidden [B, dim, T] -> log durations [B, 1, T]. ``length`` masks a
+    bucket-padded batch (see encode_text); padded positions are
+    meaningless — the caller slices to the real prefix."""
     dp = params["duration"]
+    b, _, t = hidden.shape
+    mask = None if length is None else _length_mask(
+        b, t, length, hidden.dtype
+    )
     if not cfg.use_stochastic_duration:
         k = cfg.duration_kernel
         h = conv1d(hidden, dp["conv1"], padding=k // 2)
         h = jax.nn.relu(h)
         h = _ln_channels(h, dp["norm1"], dp["norm1_b"], cfg.norm_eps)
+        if mask is not None:
+            h = h * mask
         h = conv1d(h, dp["conv2"], padding=k // 2)
         h = jax.nn.relu(h)
         h = _ln_channels(h, dp["norm2"], dp["norm2_b"], cfg.norm_eps)
@@ -552,7 +596,7 @@ def predict_log_duration(params, cfg: VitsConfig, hidden, noise_scale=None):
     if noise_scale is None:
         noise_scale = cfg.noise_scale_duration
     h = conv1d(hidden, dp["conv_pre"])
-    h = _dds_forward(dp["dds"], h, cfg)
+    h = _dds_forward(dp["dds"], h, cfg, mask=mask)
     cond = conv1d(h, dp["conv_proj"])
 
     b, _, t = hidden.shape
@@ -573,7 +617,9 @@ def predict_log_duration(params, cfg: VitsConfig, hidden, noise_scale=None):
                 -affine["log_scale"]
             )
         else:
-            latents = _conv_flow_reverse(dp["flows"][name], latents, cfg, cond)
+            latents = _conv_flow_reverse(
+                dp["flows"][name], latents, cfg, cond, mask=mask
+            )
     return latents[:, :1]
 
 
@@ -582,12 +628,14 @@ def predict_log_duration(params, cfg: VitsConfig, hidden, noise_scale=None):
 # ---------------------------------------------------------------------------
 
 
-def _wavenet_forward(wn, x, cfg: VitsConfig):
+def _wavenet_forward(wn, x, cfg: VitsConfig, mask=None):
     outputs = jnp.zeros_like(x)
     half = cfg.dim
     for i in range(cfg.prior_wavenet_layers):
         dilation = cfg.wavenet_dilation**i
         padding = (cfg.wavenet_kernel * dilation - dilation) // 2
+        if mask is not None:  # residual carries conv bias into padding
+            x = x * mask
         h = conv1d(x, wn["in"][str(i)], dilation=dilation, padding=padding)
         t_act = jnp.tanh(h[:, :half])
         s_act = jax.nn.sigmoid(h[:, half:])
@@ -602,37 +650,58 @@ def _wavenet_forward(wn, x, cfg: VitsConfig):
 
 
 @partial(jax.jit, static_argnums=(1,))
-def flow_inverse(params, cfg: VitsConfig, latents):
+def flow_inverse(params, cfg: VitsConfig, latents, length=None):
     """Residual-coupling stack in reverse: prior latents -> decoder
-    latents. latents [B, flow_size, T]."""
+    latents. latents [B, flow_size, T]; ``length`` masks a frame-bucket
+    padded batch (real prefix computes exactly the unpadded result)."""
     half = cfg.flow_size // 2
+    b, _, t = latents.shape
+    mask = None if length is None else _length_mask(
+        b, t, length, latents.dtype
+    )
     x = latents
     for i in reversed(range(cfg.prior_num_flows)):
         x = jnp.flip(x, axis=1)
         flow = params["flow"][str(i)]
         first, second = x[:, :half], x[:, half:]
         h = conv1d(first, flow["conv_pre"])
-        h = _wavenet_forward(flow["wavenet"], h, cfg)
+        h = _wavenet_forward(flow["wavenet"], h, cfg, mask=mask)
         mean = conv1d(h, flow["conv_post"])
         second = second - mean
         x = jnp.concatenate([first, second], axis=1)
+        if mask is not None:
+            x = x * mask
     return x
 
 
 @partial(jax.jit, static_argnums=(1,))
-def hifigan(params, cfg: VitsConfig, latents):
-    """latents [B, flow_size, T] -> waveform [B, samples]."""
+def hifigan(params, cfg: VitsConfig, latents, length=None):
+    """latents [B, flow_size, T] -> waveform [B, samples]. ``length``
+    (frames) masks a frame-bucket padded batch at every stage — the
+    mask upsamples with the signal, so no padded activation ever leaks
+    into a real sample's conv window."""
     dec = params["decoder"]
     slope = cfg.leaky_relu_slope
+    b, _, t = latents.shape
+    cur_len = length
+    mask = None if length is None else _length_mask(
+        b, t, cur_len, latents.dtype
+    )
     h = conv1d(latents, dec["conv_pre"], padding=3)
     n_kernels = len(cfg.resblock_kernels)
     for i, (rate, kernel) in enumerate(
         zip(cfg.upsample_rates, cfg.upsample_kernels)
     ):
+        if mask is not None:
+            h = h * mask
         h = jax.nn.leaky_relu(h, slope)
         h = conv_transpose1d(
             h, dec["up"][str(i)], stride=rate, padding=(kernel - rate) // 2
         )
+        if mask is not None:
+            cur_len = cur_len * rate
+            mask = _length_mask(b, h.shape[-1], cur_len, h.dtype)
+            h = h * mask
         acc = None
         for j in range(n_kernels):
             rb = dec["res"][str(i * n_kernels + j)]
@@ -644,8 +713,12 @@ def hifigan(params, cfg: VitsConfig, latents):
                     s, rb["convs1"][str(d_idx)], dilation=dilation,
                     padding=(k * dilation - dilation) // 2,
                 )
+                if mask is not None:
+                    s = s * mask
                 s = jax.nn.leaky_relu(s, slope)
                 s = conv1d(s, rb["convs2"][str(d_idx)], padding=(k - 1) // 2)
+                if mask is not None:
+                    s = s * mask
                 r = r + s
             acc = r if acc is None else acc + r
         h = acc / n_kernels
@@ -697,3 +770,81 @@ def synthesize(params, cfg: VitsConfig, input_ids, noise_scale=None,
     for b, w in enumerate(waveforms):
         out[b, : w.shape[0]] = w
     return out
+
+
+# ---------------------------------------------------------------------------
+# bucketed synthesis (bounded recompiles)
+# ---------------------------------------------------------------------------
+
+#: Default serving buckets. Text lengths and frame counts are padded up
+#: to the nearest edge, so the four jits compile at most once per edge
+#: ever used instead of once per distinct input length (a TTS node fed
+#: varying sentences would otherwise recompile on nearly every tick).
+TEXT_BUCKETS = (32, 64, 128, 256, 512)
+FRAME_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
+
+
+def _bucket(n: int, buckets) -> int:
+    for edge in buckets:
+        if n <= edge:
+            return edge
+    last = buckets[-1]
+    return (n + last - 1) // last * last  # oversize: multiples of the top
+
+
+def upsample_factor(cfg: VitsConfig) -> int:
+    f = 1
+    for r in cfg.upsample_rates:
+        f *= r
+    return f
+
+
+def synthesize_bucketed(params, cfg: VitsConfig, input_ids,
+                        noise_scale=None, noise_scale_duration=None,
+                        speaking_rate=None, text_buckets=TEXT_BUCKETS,
+                        frame_buckets=FRAME_BUCKETS):
+    """Bucket-padded :func:`synthesize` (B=1): pads text to a bucket
+    edge and frames to a frame bucket, threading the real lengths
+    through the masked graphs — compilation count is bounded by the
+    bucket grid while the real-prefix output matches the unpadded run
+    to float tolerance (asserted in tests/test_models.py).
+    Returns (waveform [1, samples], sliced to the true length)."""
+    if noise_scale is None:
+        noise_scale = cfg.noise_scale
+    if speaking_rate is None:
+        speaking_rate = cfg.speaking_rate
+    ids = np.asarray(input_ids)
+    assert ids.shape[0] == 1, "bucketed synthesis is batch-1 serving"
+    t = ids.shape[1]
+    tb = _bucket(t, text_buckets)
+    padded = np.zeros((1, tb), ids.dtype)
+    padded[0, :t] = ids[0]
+    t_arr = jnp.asarray(t, jnp.int32)
+    hidden, means, log_var = encode_text(
+        params, cfg, jnp.asarray(padded), length=t_arr
+    )
+    log_dur = predict_log_duration(
+        params, cfg, hidden, noise_scale=noise_scale_duration, length=t_arr
+    )
+    duration = np.ceil(
+        np.exp(np.asarray(log_dur[0, 0, :t])) / speaking_rate
+    ).astype(np.int64)
+
+    frames = int(duration.sum())
+    fb = _bucket(frames, frame_buckets)
+    prior_mean = np.zeros((fb, means.shape[-1]), np.float32)
+    prior_mean[:frames] = np.repeat(np.asarray(means[0, :t]), duration, axis=0)
+    latents = prior_mean
+    if noise_scale:
+        prior_logv = np.zeros((fb, log_var.shape[-1]), np.float32)
+        prior_logv[:frames] = np.repeat(
+            np.asarray(log_var[0, :t]), duration, axis=0
+        )
+        rng = np.random.default_rng()
+        noise = rng.standard_normal(prior_mean.shape).astype(np.float32)
+        latents = prior_mean + noise * np.exp(prior_logv) * noise_scale
+        latents[frames:] = 0.0
+    f_arr = jnp.asarray(frames, jnp.int32)
+    z = flow_inverse(params, cfg, jnp.asarray(latents.T[None]), length=f_arr)
+    wav = hifigan(params, cfg, z, length=f_arr)
+    return np.asarray(wav[:, : frames * upsample_factor(cfg)])

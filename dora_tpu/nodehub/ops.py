@@ -423,7 +423,10 @@ def make_tts() -> JaxOperator:
         def vits_step(state, inputs):
             raw = bytes(np.asarray(inputs["text"]).astype(np.uint8))
             ids = np.asarray([encode_text(raw)], np.int32)
-            wave = vits.synthesize(state, cfg, ids)
+            # Bucketed: pads text/frames to bucket edges so serving
+            # varying-length sentences compiles at most once per bucket
+            # instead of once per length (vits.synthesize_bucketed).
+            wave = vits.synthesize_bucketed(state, cfg, ids)
             return state, {"audio": jnp.asarray(wave[0])}
 
         # host=True: synthesis length is data-dependent (predicted

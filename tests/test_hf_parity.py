@@ -1369,3 +1369,51 @@ def test_marian_speculative_matches_greedy(marian_checkpoint):
     np.testing.assert_array_equal(vanilla, np.asarray(spec))
     # Deterministic fixture seeds; zero acceptance would need 10 passes.
     assert int(passes) < 10, f"no drafts accepted ({int(passes)} passes)"
+
+
+def test_vits_bucketed_synthesis_bounded_compiles(vits_checkpoint):
+    """synthesize_bucketed: N varying-length inputs produce (a) the same
+    waveform as the unpadded run on the true prefix and (b) a jit cache
+    that grows with the bucket grid, not with the input lengths —
+    VERDICT r3 item 4 (models/hf/vits.py shape note)."""
+    from dora_tpu.models.hf import vits
+
+    path, _ = vits_checkpoint
+    cfg, params = vits.load(path)
+    rng = np.random.default_rng(35)
+    lengths = [5, 9, 13, 17, 23, 29]
+    text_buckets = (16, 32)
+    frame_buckets = (256, 1024, 4096)
+
+    refs = {}
+    for t in lengths:
+        ids = rng.integers(1, cfg.vocab, size=(1, t))
+        refs[t] = (ids, vits.synthesize(params, cfg, ids))
+
+    before = {
+        "enc": vits.encode_text._cache_size(),
+        "dur": vits.predict_log_duration._cache_size(),
+        "flow": vits.flow_inverse._cache_size(),
+        "dec": vits.hifigan._cache_size(),
+    }
+    for t in lengths:
+        ids, ref = refs[t]
+        got = vits.synthesize_bucketed(
+            params, cfg, ids, text_buckets=text_buckets,
+            frame_buckets=frame_buckets,
+        )
+        assert got.shape == ref.shape, (t, got.shape, ref.shape)
+        np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-3)
+
+    grew = {
+        "enc": vits.encode_text._cache_size() - before["enc"],
+        "dur": vits.predict_log_duration._cache_size() - before["dur"],
+        "flow": vits.flow_inverse._cache_size() - before["flow"],
+        "dec": vits.hifigan._cache_size() - before["dec"],
+    }
+    assert grew["enc"] <= len(text_buckets), grew
+    assert grew["dur"] <= len(text_buckets), grew
+    assert grew["flow"] <= len(frame_buckets), grew
+    assert grew["dec"] <= len(frame_buckets), grew
+    # and strictly fewer compiles than distinct lengths (the point)
+    assert grew["enc"] < len(lengths), grew
