@@ -1,0 +1,174 @@
+"""CDR (OMG Common Data Representation, XCDR1 little-endian) for ROS2
+message specs.
+
+Drives encode/decode from the runtime-parsed ``MessageSpec`` objects
+(ros2/msg_parser.py) — the same specs that drive Arrow conversion — so
+any ``.msg`` the parser understands can ride the RTPS wire without
+generated code. Reference parity: the reference bridge serializes
+through rustdds' CDR (libraries/extensions/ros2-bridge); this is the
+dependency-free Python counterpart.
+
+Encapsulation: the RTPS serialized payload prepends a 4-byte header
+(0x00 0x01 = CDR_LE, two option bytes); alignment is relative to the
+byte after that header, which is how both are implemented here (offset
+0 = first payload byte).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable
+
+_PRIM = {
+    "bool": ("?", 1),
+    "byte": ("B", 1),
+    "char": ("B", 1),
+    "int8": ("b", 1),
+    "uint8": ("B", 1),
+    "int16": ("<h", 2),
+    "uint16": ("<H", 2),
+    "int32": ("<i", 4),
+    "uint32": ("<I", 4),
+    "int64": ("<q", 8),
+    "uint64": ("<Q", 8),
+    "float32": ("<f", 4),
+    "float64": ("<d", 8),
+}
+
+CDR_LE = b"\x00\x01\x00\x00"
+PL_CDR_LE = b"\x00\x03\x00\x00"
+
+
+class _Writer:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def align(self, n: int) -> None:
+        pad = (-len(self.buf)) % n
+        self.buf += b"\x00" * pad
+
+    def prim(self, kind: str, value) -> None:
+        fmt, size = _PRIM[kind]
+        self.align(size)
+        if kind == "bool":
+            self.buf += b"\x01" if value else b"\x00"
+        else:
+            self.buf += struct.pack(fmt, value)
+
+    def string(self, value: str) -> None:
+        raw = str(value).encode("utf-8") + b"\x00"
+        self.align(4)
+        self.buf += struct.pack("<I", len(raw))
+        self.buf += raw
+
+    def u32(self, value: int) -> None:
+        self.prim("uint32", value)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def align(self, n: int) -> None:
+        self.pos += (-self.pos) % n
+
+    def prim(self, kind: str):
+        fmt, size = _PRIM[kind]
+        self.align(size)
+        raw = self.data[self.pos : self.pos + size]
+        self.pos += size
+        if kind == "bool":
+            return raw != b"\x00"
+        return struct.unpack(fmt, raw)[0]
+
+    def string(self) -> str:
+        self.align(4)
+        (n,) = struct.unpack_from("<I", self.data, self.pos)
+        self.pos += 4
+        raw = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return raw.rstrip(b"\x00").decode("utf-8", errors="replace")
+
+    def u32(self) -> int:
+        return self.prim("uint32")
+
+
+def _encode_value(w: _Writer, tref, value, resolve: Callable) -> None:
+    if tref.is_array:
+        items = list(value if value is not None else [])
+        if tref.array_size is not None:
+            items = (items + [_zero(tref, resolve)] * tref.array_size)[
+                : tref.array_size
+            ]
+        else:
+            w.u32(len(items))
+        for item in items:
+            _encode_scalar(w, tref, item, resolve)
+    else:
+        _encode_scalar(w, tref, value, resolve)
+
+
+def _encode_scalar(w: _Writer, tref, value, resolve: Callable) -> None:
+    if tref.base == "string":
+        w.string(value if value is not None else "")
+    elif tref.base == "wstring":
+        raise NotImplementedError("wstring CDR is not supported")
+    elif tref.is_primitive:
+        w.prim(tref.base, value if value is not None else 0)
+    else:
+        spec = resolve(tref.base)
+        encode_into(w, spec, value or {}, resolve)
+
+
+def _zero(tref, resolve: Callable):
+    if tref.base == "string":
+        return ""
+    if tref.is_primitive:
+        return 0
+    return {}
+
+
+def encode_into(w: _Writer, spec, values: dict, resolve: Callable) -> None:
+    for f in spec.fields:
+        _encode_value(w, f.type, values.get(f.name), resolve)
+
+
+def encode(spec, values: dict, resolve: Callable) -> bytes:
+    """dict -> CDR bytes (without the 4-byte encapsulation header)."""
+    w = _Writer()
+    encode_into(w, spec, values, resolve)
+    # RTPS serialized payloads are padded to a 4-byte boundary.
+    w.align(4)
+    return bytes(w.buf)
+
+
+def _decode_value(r: _Reader, tref, resolve: Callable):
+    if tref.is_array:
+        n = tref.array_size if tref.array_size is not None else r.u32()
+        return [_decode_scalar(r, tref, resolve) for _ in range(n)]
+    return _decode_scalar(r, tref, resolve)
+
+
+def _decode_scalar(r: _Reader, tref, resolve: Callable):
+    if tref.base == "string":
+        return r.string()
+    if tref.base == "wstring":
+        raise NotImplementedError("wstring CDR is not supported")
+    if tref.is_primitive:
+        return r.prim(tref.base)
+    spec = resolve(tref.base)
+    return decode_from(r, spec, resolve)
+
+
+def decode_from(r: _Reader, spec, resolve: Callable) -> dict:
+    return {f.name: _decode_value(r, f.type, resolve) for f in spec.fields}
+
+
+def decode(spec, data: bytes, resolve: Callable) -> dict:
+    """CDR bytes (no encapsulation header) -> dict."""
+    return decode_from(_Reader(data), spec, resolve)
+
+
+def roundtrip_check(spec, values: dict, resolve: Callable) -> dict:
+    return decode(spec, encode(spec, values, resolve), resolve)

@@ -1,0 +1,557 @@
+"""Minimal RTPS 2.3 participant — DDS interop without a ROS2 install.
+
+The reference bridge's selling point is that it speaks DDS directly
+(ros2-client + rustdds, libraries/extensions/ros2-bridge/Cargo.toml) —
+no ROS2 environment needed. This is the Python-native counterpart: a
+self-contained RTPS participant over real UDP sockets implementing the
+discovery + best-effort data subset the bridge needs:
+
+* **SPDP** — participant discovery: periodic ``DATA(p)`` announcements
+  (PL_CDR_LE parameter lists) on the well-known multicast group
+  (239.255.0.1, port ``7400 + 250*domain``) AND, for environments
+  where multicast is filtered, on the spec's well-known unicast ports
+  (``7410 + 2*participant_id``) of every address in
+  ``DORA_RTPS_PEERS`` (comma-separated, default 127.0.0.1).
+* **SEDP** — endpoint discovery: ``DATA(w)`` / ``DATA(r)`` publication
+  and subscription announcements (topic, type, user-traffic locator)
+  unicast to each discovered participant's metatraffic locator.
+* **User data** — best-effort ``DATA`` submessages with CDR_LE
+  payloads sent straight to every matched reader's user locator.
+
+Reliable QoS (HEARTBEAT/ACKNACK/GAP) is NOT implemented — matching the
+bridge's sensor-stream usage (best-effort, keep-last). Messages use
+the standard ROS2 mangling (topic ``rt/<name>``, type
+``pkg::msg::dds_::Type_``) so the frames are what any DDS stack
+expects; cross-vendor interop cannot be exercised in this offline
+image (no other DDS exists here) and is documented as such in
+PARITY.md. The wire format is validated by two independent
+participants in separate processes exchanging over real sockets
+(tests/test_ros2_rtps.py).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+PROTOCOL = b"RTPS"
+VERSION = (2, 3)
+VENDOR = b"\x01\x21"  # unassigned range; parsers must accept any vendor
+
+# Submessage ids
+_INFO_TS = 0x09
+_DATA = 0x15
+
+# Builtin entity ids (RTPS 2.3 table 9.2)
+ENT_SPDP_W = 0x000100C2
+ENT_SPDP_R = 0x000100C7
+ENT_SEDP_PUB_W = 0x000003C2
+ENT_SEDP_PUB_R = 0x000003C7
+ENT_SEDP_SUB_W = 0x000004C2
+ENT_SEDP_SUB_R = 0x000004C7
+
+# Parameter ids
+PID_SENTINEL = 0x0001
+PID_LEASE = 0x0002
+PID_TOPIC_NAME = 0x0005
+PID_TYPE_NAME = 0x0007
+PID_PROTOCOL_VERSION = 0x0015
+PID_VENDORID = 0x0016
+PID_UNICAST_LOCATOR = 0x002F
+PID_DEFAULT_UNICAST_LOCATOR = 0x0031
+PID_METATRAFFIC_UNICAST_LOCATOR = 0x0032
+PID_PARTICIPANT_GUID = 0x0050
+PID_ENDPOINT_GUID = 0x005A
+PID_BUILTIN_ENDPOINTS = 0x0058
+PID_RELIABILITY = 0x001A
+
+LOCATOR_UDPV4 = 1
+
+MULTICAST_GROUP = "239.255.0.1"
+
+
+def _ports(domain: int) -> tuple[int, int]:
+    """(multicast discovery port, unicast discovery port base)."""
+    base = 7400 + 250 * domain
+    return base, base + 10
+
+
+def _mangle_topic(topic: str) -> str:
+    return "rt" + (topic if topic.startswith("/") else "/" + topic)
+
+
+def _mangle_type(msg_type: str) -> str:
+    pkg, _, name = msg_type.partition("/")
+    return f"{pkg}::msg::dds_::{name}_"
+
+
+def _locator(addr: str, port: int) -> bytes:
+    ip = socket.inet_aton(addr)
+    return struct.pack("<iI", LOCATOR_UDPV4, port) + b"\x00" * 12 + ip
+
+
+def _parse_locator(raw: bytes) -> tuple[str, int] | None:
+    kind, port = struct.unpack_from("<iI", raw, 0)
+    if kind != LOCATOR_UDPV4:
+        return None
+    return socket.inet_ntoa(raw[20:24]), port
+
+
+def _param(pid: int, value: bytes) -> bytes:
+    pad = (-len(value)) % 4
+    return struct.pack("<HH", pid, len(value) + pad) + value + b"\x00" * pad
+
+
+def _param_string(pid: int, s: str) -> bytes:
+    raw = s.encode() + b"\x00"
+    return _param(pid, struct.pack("<I", len(raw)) + raw)
+
+
+def _parse_params(data: bytes) -> list[tuple[int, bytes]]:
+    out, pos = [], 0
+    while pos + 4 <= len(data):
+        pid, length = struct.unpack_from("<HH", data, pos)
+        pos += 4
+        if pid == PID_SENTINEL:
+            break
+        out.append((pid, data[pos : pos + length]))
+        pos += length
+    return out
+
+
+def _param_str_value(raw: bytes) -> str:
+    (n,) = struct.unpack_from("<I", raw, 0)
+    return raw[4 : 4 + n].rstrip(b"\x00").decode(errors="replace")
+
+
+@dataclass
+class _Peer:
+    guid_prefix: bytes
+    meta: tuple[str, int]
+    seen: float = 0.0
+    sedp_sent: bool = False
+
+
+@dataclass
+class _RemoteEndpoint:
+    guid: bytes
+    topic: str
+    type_name: str
+    locator: tuple[str, int] | None
+
+
+@dataclass
+class _Writer:
+    entity_id: int
+    topic: str
+    type_name: str
+    seq: int = 0
+
+
+@dataclass
+class _Reader:
+    entity_id: int
+    topic: str
+    type_name: str
+    callback: object = None
+    history: list = field(default_factory=list)
+
+
+class RtpsParticipant:
+    """One DDS participant: discovery threads + best-effort data plane."""
+
+    ANNOUNCE_PERIOD_S = float(os.environ.get("DORA_RTPS_ANNOUNCE_S", "0.25"))
+
+    def __init__(self, domain_id: int = 0, name: str = "dora_tpu"):
+        self.domain = domain_id
+        self.name = name
+        self.guid_prefix = (
+            VENDOR + os.getpid().to_bytes(4, "big")
+            + random.randbytes(6)
+        )
+        self._writers: dict[int, _Writer] = {}
+        self._readers: dict[int, _Reader] = {}
+        self._remote_writers: dict[bytes, _RemoteEndpoint] = {}
+        self._remote_readers: dict[bytes, _RemoteEndpoint] = {}
+        self._peers: dict[bytes, _Peer] = {}
+        self._next_entity = 1
+        self._lock = threading.RLock()
+        self._closed = threading.Event()
+
+        mcast_port, ucast_base = _ports(domain_id)
+        # Metatraffic unicast: the spec's well-known ports so unicast
+        # initial-peers discovery works without any out-of-band channel.
+        self._meta_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.participant_id = 0
+        for pid in range(64):
+            try:
+                self._meta_sock.bind(("0.0.0.0", ucast_base + 2 * pid))
+                self.participant_id = pid
+                break
+            except OSError:
+                continue
+        else:
+            self._meta_sock.bind(("0.0.0.0", 0))
+        self.meta_port = self._meta_sock.getsockname()[1]
+
+        # User traffic: ephemeral, advertised through discovery.
+        self._user_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._user_sock.bind(("0.0.0.0", 0))
+        self.user_port = self._user_sock.getsockname()[1]
+
+        # SPDP multicast receive (best effort — may be filtered).
+        self._mcast_port = mcast_port
+        self._mcast_rx = None
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if hasattr(socket, "SO_REUSEPORT"):
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind(("0.0.0.0", mcast_port))
+            mreq = socket.inet_aton(MULTICAST_GROUP) + socket.inet_aton(
+                "0.0.0.0"
+            )
+            s.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+            s.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+            self._mcast_rx = s
+        except OSError:
+            self._mcast_rx = None
+
+        self._send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            self._send_sock.setsockopt(
+                socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1
+            )
+            self._send_sock.setsockopt(
+                socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1
+            )
+        except OSError:
+            pass
+
+        self.peers_hint = [
+            p.strip()
+            for p in os.environ.get("DORA_RTPS_PEERS", "127.0.0.1").split(",")
+            if p.strip()
+        ]
+
+        self._threads = [
+            threading.Thread(target=self._announce_loop, daemon=True,
+                             name="rtps-announce"),
+            threading.Thread(target=self._rx_loop, daemon=True,
+                             args=(self._meta_sock,), name="rtps-meta"),
+            threading.Thread(target=self._rx_loop, daemon=True,
+                             args=(self._user_sock,), name="rtps-user"),
+        ]
+        if self._mcast_rx is not None:
+            self._threads.append(
+                threading.Thread(target=self._rx_loop, daemon=True,
+                                 args=(self._mcast_rx,), name="rtps-mcast")
+            )
+        for t in self._threads:
+            t.start()
+
+    # -- wire helpers -------------------------------------------------------
+
+    def _header(self) -> bytes:
+        return (
+            PROTOCOL + bytes(VERSION) + VENDOR + self.guid_prefix
+        )
+
+    def _data_submsg(self, reader_ent: int, writer_ent: int, seq: int,
+                     payload: bytes) -> bytes:
+        flags = 0x01 | 0x04  # little-endian | data present
+        # Entity ids are 4-octet arrays (key+kind), never endian-swapped;
+        # everything else honors the E flag (little-endian).
+        body = (
+            struct.pack("<HH", 0, 16)
+            + struct.pack(">II", reader_ent, writer_ent)
+            + struct.pack("<iI", seq >> 32, seq & 0xFFFFFFFF)
+            + payload
+        )
+        return struct.pack("<BBH", _DATA, flags, len(body)) + body
+
+    def _send(self, dest: tuple[str, int], submsgs: bytes) -> None:
+        try:
+            self._send_sock.sendto(self._header() + submsgs, dest)
+        except OSError:
+            pass
+
+    # -- announcements ------------------------------------------------------
+
+    def _spdp_payload(self) -> bytes:
+        guid = self.guid_prefix + struct.pack(">I", 0x000001C1)
+        params = b"".join(
+            [
+                _param(PID_PROTOCOL_VERSION, bytes(VERSION) + b"\x00\x00"),
+                _param(PID_VENDORID, VENDOR + b"\x00\x00"),
+                _param(PID_PARTICIPANT_GUID, guid),
+                _param(
+                    PID_METATRAFFIC_UNICAST_LOCATOR,
+                    _locator(self._local_addr(), self.meta_port),
+                ),
+                _param(
+                    PID_DEFAULT_UNICAST_LOCATOR,
+                    _locator(self._local_addr(), self.user_port),
+                ),
+                _param(PID_BUILTIN_ENDPOINTS, struct.pack("<I", 0x0000000F)),
+                _param(PID_LEASE, struct.pack("<iI", 100, 0)),
+                _param(PID_SENTINEL, b""),
+            ]
+        )
+        from dora_tpu.ros2.cdr import PL_CDR_LE
+
+        return PL_CDR_LE + params
+
+    def _local_addr(self) -> str:
+        return "127.0.0.1"
+
+    def _sedp_payload(self, topic: str, type_name: str, guid_ent: int,
+                      locator_port: int) -> bytes:
+        from dora_tpu.ros2.cdr import PL_CDR_LE
+
+        guid = self.guid_prefix + struct.pack(">I", guid_ent)
+        params = b"".join(
+            [
+                _param_string(PID_TOPIC_NAME, topic),
+                _param_string(PID_TYPE_NAME, type_name),
+                _param(PID_ENDPOINT_GUID, guid),
+                _param(
+                    PID_UNICAST_LOCATOR,
+                    _locator(self._local_addr(), locator_port),
+                ),
+                _param(
+                    PID_RELIABILITY, struct.pack("<iiI", 1, 0, 0)
+                ),  # best-effort
+                _param(PID_SENTINEL, b""),
+            ]
+        )
+        return PL_CDR_LE + params
+
+    def _announce_loop(self) -> None:
+        seq = 0
+        while not self._closed.is_set():
+            seq += 1
+            spdp = self._data_submsg(
+                ENT_SPDP_R, ENT_SPDP_W, seq, self._spdp_payload()
+            )
+            dests = [(MULTICAST_GROUP, self._mcast_port)]
+            _, ucast_base = _ports(self.domain)
+            for host in self.peers_hint:
+                for pid in range(8):
+                    port = ucast_base + 2 * pid
+                    if host in ("127.0.0.1", "localhost") and (
+                        port == self.meta_port
+                    ):
+                        continue
+                    dests.append((host, port))
+            for dest in dests:
+                self._send(dest, spdp)
+            self._sedp_announce()
+            self._closed.wait(self.ANNOUNCE_PERIOD_S)
+
+    def _sedp_announce(self) -> None:
+        with self._lock:
+            peers = [p for p in self._peers.values()]
+            writers = list(self._writers.values())
+            readers = list(self._readers.values())
+        for peer in peers:
+            msgs = b""
+            for i, w in enumerate(writers):
+                payload = self._sedp_payload(
+                    w.topic, w.type_name, w.entity_id, self.user_port
+                )
+                msgs += self._data_submsg(
+                    ENT_SEDP_PUB_R, ENT_SEDP_PUB_W, i + 1, payload
+                )
+            for i, r in enumerate(readers):
+                payload = self._sedp_payload(
+                    r.topic, r.type_name, r.entity_id, self.user_port
+                )
+                msgs += self._data_submsg(
+                    ENT_SEDP_SUB_R, ENT_SEDP_SUB_W, i + 1, payload
+                )
+            if msgs:
+                self._send(peer.meta, msgs)
+
+    # -- receive path -------------------------------------------------------
+
+    def _rx_loop(self, sock: socket.socket) -> None:
+        sock.settimeout(0.2)
+        while not self._closed.is_set():
+            try:
+                data, _addr = sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                self._handle(data)
+            except Exception:
+                continue  # malformed frames must never kill the pump
+
+    def _handle(self, data: bytes) -> None:
+        if len(data) < 20 or data[:4] != PROTOCOL:
+            return
+        src_prefix = data[8:20]
+        if src_prefix == self.guid_prefix:
+            return
+        pos = 20
+        while pos + 4 <= len(data):
+            sub_id, flags, length = struct.unpack_from("<BBH", data, pos)
+            if not flags & 0x01:
+                return  # big-endian peers unsupported (none in practice)
+            body = data[pos + 4 : pos + 4 + length]
+            pos += 4 + length
+            if sub_id != _DATA or len(body) < 24:
+                continue
+            _extra, to_qos = struct.unpack_from("<HH", body, 0)
+            reader_ent, writer_ent = struct.unpack_from(">II", body, 4)
+            # octetsToInlineQos counts from the octet after itself
+            # (i.e. from body offset 4) to the inline-qos/payload.
+            payload = body[4 + to_qos :]
+            if writer_ent == ENT_SPDP_W:
+                self._on_spdp(payload)
+            elif writer_ent == ENT_SEDP_PUB_W:
+                self._on_sedp(src_prefix, payload, is_writer=True)
+            elif writer_ent == ENT_SEDP_SUB_W:
+                self._on_sedp(src_prefix, payload, is_writer=False)
+            else:
+                self._on_user_data(src_prefix, writer_ent, reader_ent,
+                                   payload)
+
+    def _on_spdp(self, payload: bytes) -> None:
+        if len(payload) < 4:
+            return
+        params = _parse_params(payload[4:])
+        guid = meta = None
+        for pid, value in params:
+            if pid == PID_PARTICIPANT_GUID and len(value) >= 12:
+                guid = value[:12]
+            elif pid == PID_METATRAFFIC_UNICAST_LOCATOR and len(value) >= 24:
+                meta = _parse_locator(value)
+        if guid is None or meta is None or guid == self.guid_prefix:
+            return
+        with self._lock:
+            peer = self._peers.get(guid)
+            if peer is None:
+                self._peers[guid] = _Peer(guid, meta, time.monotonic())
+            else:
+                peer.meta = meta
+                peer.seen = time.monotonic()
+
+    def _on_sedp(self, src_prefix: bytes, payload: bytes,
+                 is_writer: bool) -> None:
+        if len(payload) < 4:
+            return
+        params = _parse_params(payload[4:])
+        topic = type_name = None
+        guid = None
+        locator = None
+        for pid, value in params:
+            if pid == PID_TOPIC_NAME:
+                topic = _param_str_value(value)
+            elif pid == PID_TYPE_NAME:
+                type_name = _param_str_value(value)
+            elif pid == PID_ENDPOINT_GUID and len(value) >= 16:
+                guid = value
+            elif pid in (PID_UNICAST_LOCATOR, PID_DEFAULT_UNICAST_LOCATOR):
+                locator = _parse_locator(value) or locator
+        if not topic or guid is None:
+            return
+        ep = _RemoteEndpoint(guid, topic, type_name or "", locator)
+        with self._lock:
+            if is_writer:
+                self._remote_writers[guid] = ep
+            else:
+                self._remote_readers[guid] = ep
+
+    def _on_user_data(self, src_prefix: bytes, writer_ent: int,
+                      reader_ent: int, payload: bytes) -> None:
+        """Route a user DATA to local readers on the writer's topic."""
+        writer_guid = src_prefix + struct.pack(">I", writer_ent)
+        with self._lock:
+            ep = self._remote_writers.get(writer_guid)
+            readers = list(self._readers.values())
+        if ep is None:
+            return
+        if len(payload) < 4:
+            return
+        body = payload[4:]  # strip encapsulation header
+        for r in readers:
+            if r.topic == ep.topic:
+                if r.callback is not None:
+                    r.callback(body)
+                else:
+                    r.history.append(body)
+
+    # -- public API ---------------------------------------------------------
+
+    def create_writer(self, topic: str, msg_type: str) -> "RtpsWriter":
+        with self._lock:
+            ent = (self._next_entity << 8) | 0x03  # user writer, no key
+            self._next_entity += 1
+            w = _Writer(ent, _mangle_topic(topic), _mangle_type(msg_type))
+            self._writers[ent] = w
+        self._sedp_announce()
+        return RtpsWriter(self, w)
+
+    def create_reader(self, topic: str, msg_type: str,
+                      callback=None) -> "_Reader":
+        with self._lock:
+            ent = (self._next_entity << 8) | 0x04  # user reader, no key
+            self._next_entity += 1
+            r = _Reader(ent, _mangle_topic(topic), _mangle_type(msg_type),
+                        callback)
+            self._readers[ent] = r
+        self._sedp_announce()
+        return r
+
+    def matched_readers(self, topic: str) -> list[_RemoteEndpoint]:
+        with self._lock:
+            return [
+                ep for ep in self._remote_readers.values()
+                if ep.topic == topic and ep.locator is not None
+            ]
+
+    def wait_for_match(self, topic: str, timeout: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout
+        mangled = _mangle_topic(topic)
+        while time.monotonic() < deadline:
+            if self.matched_readers(mangled):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        self._closed.set()
+        for t in self._threads:
+            t.join(timeout=1)
+        for s in (self._meta_sock, self._user_sock, self._send_sock,
+                  self._mcast_rx):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class RtpsWriter:
+    def __init__(self, participant: RtpsParticipant, writer: _Writer):
+        self._p = participant
+        self._w = writer
+
+    def publish_cdr(self, cdr_bytes: bytes) -> None:
+        """Send an already-CDR-encoded payload to every matched reader."""
+        from dora_tpu.ros2.cdr import CDR_LE
+
+        self._w.seq += 1
+        submsg = self._p._data_submsg(
+            0, self._w.entity_id, self._w.seq, CDR_LE + cdr_bytes
+        )
+        for ep in self._p.matched_readers(self._w.topic):
+            self._p._send(ep.locator, submsg)
