@@ -1,0 +1,26 @@
+// CMake-built C++ node: doubles every byte of its input and sends it
+// on (reference: examples/cmake-dataflow's node built via CMakeLists
+// instead of a raw compiler line).
+#include <cstdio>
+#include <vector>
+
+#include "dora_node_api.hpp"
+
+int main() {
+  dora::Node node;
+  int doubled = 0;
+  while (auto event = node.next()) {
+    if (event.type() == DORA_EVENT_STOP) break;
+    if (event.type() != DORA_EVENT_INPUT) continue;
+    const uint8_t* bytes = event.data();
+    std::vector<uint8_t> out(event.size());
+    for (size_t i = 0; i < out.size(); ++i) {
+      out[i] = static_cast<uint8_t>(bytes[i] * 2);
+    }
+    node.send_output("doubled", out.data(), out.size(),
+                     event.encoding().c_str());
+    doubled++;
+  }
+  std::fprintf(stderr, "doubled %d inputs\n", doubled);
+  return doubled > 0 ? 0 : 1;
+}

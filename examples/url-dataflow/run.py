@@ -1,0 +1,76 @@
+"""URL-sourced dataflow (reference: examples/rust-dataflow-url — a node
+whose ``path:`` is a URL, fetched by the daemon through dora-download).
+
+Serves a node script over a real local HTTP server, points the
+dataflow's ``path:`` at the URL, and runs it end to end: the daemon
+downloads the source into the content-addressed cache
+(dora_tpu/download.py, chmod 764 like the reference) and spawns it.
+
+    python examples/url-dataflow/run.py
+"""
+
+from __future__ import annotations
+
+import http.server
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+from pathlib import Path
+
+NODE_SOURCE = textwrap.dedent('''
+    """Counter node fetched over HTTP by the daemon."""
+    from dora_tpu.node import Node
+
+    with Node() as node:
+        sent = 0
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            node.send_output("count", bytes([sent]), {})
+            sent += 1
+            if sent >= 3:
+                break
+''')
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="dora-url-example-") as tmp:
+        tmp_path = Path(tmp)
+        (tmp_path / "counter_node.py").write_text(NODE_SOURCE)
+
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+            *a, directory=str(tmp_path), **kw
+        )
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+
+        dataflow = tmp_path / "dataflow.yml"
+        dataflow.write_text(textwrap.dedent(f"""
+            nodes:
+              - id: counter
+                path: http://127.0.0.1:{port}/counter_node.py
+                inputs:
+                  tick: dora/timer/millis/50
+                outputs: [count]
+
+              - id: printer
+                path: module:dora_tpu.nodehub.terminal_print
+                inputs:
+                  count: counter/count
+        """))
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "dora_tpu.cli.main", "daemon",
+                "--run-dataflow", str(dataflow),
+            ],
+            cwd=tmp, timeout=120,
+        )
+        server.shutdown()
+        return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,3 +33,50 @@ def test_multiple_daemons_example(tmp_path):
     )
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     assert "finished successfully across two daemons" in proc.stdout
+
+
+def test_rerun_viewer_example():
+    """camera -> detector -> rerun sink (reference examples/rerun-viewer):
+    headless mode must write the self-contained HTML replay."""
+    out_dir = REPO / "examples" / "rerun-viewer" / "rerun-out"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dora_tpu.cli.main", "daemon",
+            "--run-dataflow",
+            str(REPO / "examples" / "rerun-viewer" / "dataflow.yml"),
+        ],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "finished successfully" in proc.stdout
+    assert (out_dir / "replay.html").exists(), list(out_dir.glob("*"))
+
+
+def test_url_dataflow_example(tmp_path):
+    """URL-sourced node fetched over live HTTP through download.py
+    (reference examples/rust-dataflow-url)."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "url-dataflow" / "run.py")],
+        capture_output=True, text=True, timeout=180, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+
+
+def test_cmake_dataflow_example():
+    """CMake-configured native node builds via `dora-tpu build` and runs
+    (reference examples/cmake-dataflow)."""
+    df = REPO / "examples" / "cmake-dataflow" / "dataflow.yml"
+    build = subprocess.run(
+        [sys.executable, "-m", "dora_tpu.cli.main", "build", str(df)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, f"{build.stdout}\n{build.stderr}"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dora_tpu.cli.main", "daemon",
+            "--run-dataflow", str(df),
+        ],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "finished successfully" in proc.stdout
