@@ -5,17 +5,31 @@ Primary metric — the reference's headline axis (README.md:52 benches
 message from one node process to another through the daemon data plane
 (shared-memory regions + shmem control channels, zero-copy receive).
 
-``vs_baseline`` is the speedup over a same-machine TCP-loopback transfer
-of the same payload (the copying transport a ROS2-style system uses
-locally), measured in the same run.
+Robustness (round 4): the latency is the median of ``RUNS`` independent
+dataflow runs (fresh daemon + fresh node processes each), with the
+min..max spread reported alongside, and the TCP-loopback baseline is
+measured in the same process interleaved between runs — so a noisy
+machine shows up as spread and a shifted baseline rather than silently
+masquerading as a code regression (this is exactly what made the r3
+number unreadable: see BENCHMARKS.md "Headline" table).
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Additionally the line carries the north-star serving proof: the
+camera → VLM-2B end-to-end FPS through the real daemon (the
+BASELINE.md ≥25 FPS axis), measured by ``bench_vlm.bench_e2e`` with the
+round-3 best config (int8 decode + pipelined ticks). If no TPU is
+attached (or the serving bench fails) the primary metric still prints,
+with ``e2e_fps: null`` and the reason.
+
+Prints exactly ONE JSON line (the last line of stdout):
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
+   "runs": N, "spread_us": [lo, hi], "baseline_us": ...,
+   "e2e_fps": ..., "e2e_vs_north_star": ...}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import statistics
 import sys
@@ -26,7 +40,8 @@ import time
 from pathlib import Path
 
 SIZE = 40 * 1024 * 1024
-ROUNDS = 30
+ROUNDS = 30  # messages per run
+RUNS = int(os.environ.get("BENCH_LATENCY_RUNS", "5"))
 
 
 def tcp_loopback_p50_us() -> float:
@@ -49,7 +64,6 @@ def tcp_loopback_p50_us() -> float:
                         return
                     n += len(chunk)
                 conn.sendall(b"a")  # ack
-
     thread = threading.Thread(target=serve, daemon=True)
     thread.start()
     client = socket.create_connection(("127.0.0.1", port))
@@ -144,21 +158,76 @@ def dataflow_p50_us(workdir: Path) -> float:
     return json.loads((workdir / "latency.json").read_text())
 
 
+def serving_fps() -> dict:
+    """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
+
+    Round-3 best-known config (BENCHMARKS.md "pipelined serving"):
+    int8 decode weights + pipelined async ticks, 4 new tokens per frame.
+    Returns {"fps": float | None, "note": str, ...}.
+    """
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception as exc:  # pragma: no cover - broken jax install
+        return {"fps": None, "note": f"jax unavailable: {exc}"}
+    if platform in ("cpu",):
+        return {"fps": None, "note": f"no accelerator (backend={platform})"}
+
+    os.environ.setdefault("DORA_INT8_DECODE", "1")
+    os.environ.setdefault("DORA_PIPELINE_DEPTH", "8")
+    frames = int(os.environ.get("BENCH_FRAMES", "400"))
+    from bench_vlm import bench_e2e
+
+    with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-e2e-") as tmp:
+        data = bench_e2e(Path(tmp), max_new=4, frames=frames, size="bench")
+    return {
+        "fps": data["fps"],
+        "note": "camera->vlm-2b, 4 tok/frame, int8+pipeline-depth-8",
+        "outputs": data.get("measured_outputs"),
+        "p50_gap_ms": round(data.get("p50_gap_ms", 0.0), 1),
+    }
+
+
 def main() -> int:
     sys.path.insert(0, str(Path(__file__).resolve().parent))
-    with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-") as tmp:
-        ours = dataflow_p50_us(Path(tmp))
-        baseline = tcp_loopback_p50_us()
-    print(
-        json.dumps(
-            {
-                "metric": "40MB inter-node message p50 latency",
-                "value": round(ours, 1),
-                "unit": "us",
-                "vs_baseline": round(baseline / ours, 2),
-            }
-        )
-    )
+
+    # Interleave dataflow runs and baseline runs so both see the same
+    # machine conditions; medians of each side make the ratio robust.
+    ours_runs: list[float] = []
+    base_runs: list[float] = []
+    for i in range(RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-") as tmp:
+            ours_runs.append(dataflow_p50_us(Path(tmp)))
+        base_runs.append(tcp_loopback_p50_us())
+        print(f"# run {i + 1}/{RUNS}: ours {ours_runs[-1]:.1f} us, "
+              f"baseline {base_runs[-1]:.1f} us", file=sys.stderr)
+    ours = statistics.median(ours_runs)
+    baseline = statistics.median(base_runs)
+
+    try:
+        e2e = serving_fps()
+    except Exception as exc:  # serving bench must never sink the headline
+        e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
+
+    record = {
+        "metric": "40MB inter-node message p50 latency",
+        "value": round(ours, 1),
+        "unit": "us",
+        "vs_baseline": round(baseline / ours, 2),
+        "runs": RUNS,
+        "spread_us": [round(min(ours_runs), 1), round(max(ours_runs), 1)],
+        "baseline_us": round(baseline, 1),
+        "baseline_spread_us": [
+            round(min(base_runs), 1), round(max(base_runs), 1)
+        ],
+        "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
+        "e2e_vs_north_star": (
+            None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)
+        ),
+        "e2e_note": e2e["note"],
+    }
+    print(json.dumps(record))
     return 0
 
 
