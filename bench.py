@@ -176,15 +176,29 @@ def serving_fps() -> dict:
 
     os.environ.setdefault("DORA_INT8_DECODE", "1")
     os.environ.setdefault("DORA_PIPELINE_DEPTH", "8")
-    frames = int(os.environ.get("BENCH_FRAMES", "400"))
+    # The camera stream must outlive the model's jit compile (~60-90 s
+    # on the tunneled chip) by enough to reach steady state: 6000 frames
+    # at the 20 ms tick is a 2-minute stream (the r3 methodology).
+    # 400 frames ends during compile and measures a meaningless burst
+    # of flushed tail frames — exactly what the validity floor rejects.
+    frames = int(os.environ.get("BENCH_FRAMES", "6000"))
     from bench_vlm import bench_e2e
 
     with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-e2e-") as tmp:
         data = bench_e2e(Path(tmp), max_new=4, frames=frames, size="bench")
+    measured = data.get("measured_outputs") or 0
+    if measured < 30:
+        return {
+            "fps": None,
+            "note": (
+                f"invalid: only {measured} steady-state outputs — stream "
+                "shorter than model compile; raise BENCH_FRAMES"
+            ),
+        }
     return {
         "fps": data["fps"],
         "note": "camera->vlm-2b, 4 tok/frame, int8+pipeline-depth-8",
-        "outputs": data.get("measured_outputs"),
+        "outputs": measured,
         "p50_gap_ms": round(data.get("p50_gap_ms", 0.0), 1),
     }
 

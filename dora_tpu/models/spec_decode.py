@@ -70,7 +70,7 @@ def lookup(history, hist_len, seq: int, k: int, ngram: int):
 
 def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
              seq: int, verify, k: int = SPEC_K, ngram: int = SPEC_NGRAM,
-             adaptive: bool = True, return_stats: bool = False):
+             adaptive: bool | None = None, return_stats: bool = False):
     """The speculation while_loop (call inside a jit).
 
     ``history`` is a [seq] int32 buffer holding the known token ids
@@ -91,6 +91,10 @@ def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
     Returns (tokens [1, max_new_tokens], model_passes); with
     ``return_stats`` additionally the number of full k+1 passes.
     """
+    if adaptive is None:
+        import os
+
+        adaptive = os.environ.get("DORA_SPEC_ADAPTIVE", "1") not in ("", "0")
     out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
     out = out.at[0].set(first)
 

@@ -425,11 +425,21 @@ def _generate_spec_jit(params, cfg: VLMConfig, images, prompt_ids,
     )
     history = history.at[t_prompt].set(first[0])
 
+    use_fused = fused_decode_ready(params)
+
     def verify(chunk, n_emitted, caches):
         # generated token j lives at cache position `position + j`
         # (image patches + prompt precede it); `chunk[0, 0]` is
         # generated index n_emitted-1.
         cache_index = position + n_emitted - 1
+        if chunk.shape[1] == 1 and use_fused:
+            # Adaptive plain pass == one greedy decode step: take the
+            # fused kernel tier so backing off never costs more than
+            # the best vanilla decode.
+            nxt, new_caches = decode_step_fused(
+                params, cfg, chunk[:, 0], caches, cache_index
+            )
+            return nxt, new_caches
         chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(cfg.max_seq)[None, None, None, :]
