@@ -94,7 +94,12 @@ def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
     if adaptive is None:
         import os
 
-        adaptive = os.environ.get("DORA_SPEC_ADAPTIVE", "1") not in ("", "0")
+        # Default OFF: measured on-chip the lax.cond dual-mode costs
+        # ~1 ms/pass (the branch carries the KV pytree) — more than the
+        # chunk/plain delta it saves; the fused M-row chunk verify is
+        # the mechanism that actually bounds the worst case
+        # (BENCHMARKS.md round-4 speculation matrix).
+        adaptive = os.environ.get("DORA_SPEC_ADAPTIVE", "0") not in ("", "0")
     out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
     out = out.at[0].set(first)
 

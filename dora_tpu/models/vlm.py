@@ -293,13 +293,25 @@ def decode_step_fused(params, cfg: VLMConfig, token, caches, position):
     Requires :func:`fused_decode_ready`. token: [1] int32. Returns
     (next_token [1] int32, caches).
     """
+    return decode_chunk_fused(params, cfg, token[:, None], caches, position)
+
+
+def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
+    """M-row fused greedy pass: rows are consecutive tokens at positions
+    ``position..position+M-1`` (the speculative-verify shape — one
+    weight stream serves all rows). tokens: [1, M] int32. Returns
+    (greedy [M] int32 — greedy[i] continues the prefix through row i —
+    and the in-place-updated caches). Caller guarantees
+    ``position + M <= max_seq`` (speculation headroom)."""
     from dora_tpu.ops import decode_block as DB
 
     dtype = L.compute_dtype()
-    x = params["embed"].astype(dtype)[token]  # [1, dim]
+    m = tokens.shape[1]
+    x = params["embed"].astype(dtype)[tokens[0]]  # [M, dim]
     cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim)
-    cos_full, sin_signed = DB.rope_rows(cos_t, sin_t, position)
+    cos_rows, sin_rows = DB.rope_rows(cos_t, sin_t, position, m)
     n_qkv = (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim
+    attn = DB.attention_step if m == 1 else DB.attention_chunk_step
     new_caches = {}
     for i in range(cfg.layers):
         blk = params["blocks"][str(i)]
@@ -308,9 +320,9 @@ def decode_step_fused(params, cfg: VLMConfig, token, caches, position):
         bqkv = blk.get("bqkv")
         if bqkv is None:
             bqkv = jnp.zeros((n_qkv,), jnp.float32)
-        x, kc, vc = DB.attention_step(
+        x, kc, vc = attn(
             x, blk["attn_norm"], blk["wqkv"]["int8"], blk["wqkv"]["scale"],
-            bqkv, cos_full, sin_signed, kc, vc,
+            bqkv, cos_rows, sin_rows, kc, vc,
             blk["wo"]["int8"], blk["wo"]["scale"], position,
             heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
         )
@@ -323,11 +335,11 @@ def decode_step_fused(params, cfg: VLMConfig, token, caches, position):
             blk["w_gateup"]["scale"], bgu, blk["w_down"]["int8"],
             blk["w_down"]["scale"],
         )
-    nxt = DB.lm_head_argmax(
+    greedy = DB.lm_head_argmax(
         x, params["out_norm"], params["lm_head"]["int8"],
         params["lm_head"]["scale"],
     )
-    return nxt, new_caches
+    return greedy, new_caches
 
 
 def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
@@ -432,14 +444,15 @@ def _generate_spec_jit(params, cfg: VLMConfig, images, prompt_ids,
         # (image patches + prompt precede it); `chunk[0, 0]` is
         # generated index n_emitted-1.
         cache_index = position + n_emitted - 1
-        if chunk.shape[1] == 1 and use_fused:
-            # Adaptive plain pass == one greedy decode step: take the
-            # fused kernel tier so backing off never costs more than
-            # the best vanilla decode.
-            nxt, new_caches = decode_step_fused(
-                params, cfg, chunk[:, 0], caches, cache_index
+        if use_fused:
+            # Both pass widths ride the fused kernel tier (the M-row
+            # chunk kernel streams the weights once for all rows), so a
+            # verification pass costs ~one fused decode step and
+            # speculation cannot meaningfully lose even at zero
+            # acceptance — see BENCHMARKS.md.
+            return decode_chunk_fused(
+                params, cfg, chunk, caches, cache_index
             )
-            return nxt, new_caches
         chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(cfg.max_seq)[None, None, None, :]

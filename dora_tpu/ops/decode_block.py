@@ -297,6 +297,266 @@ def attention_step(
 
 
 # ---------------------------------------------------------------------------
+# chunk attention (speculative verify: M rows in one pass)
+# ---------------------------------------------------------------------------
+
+
+def _attn_chunk_kernel(
+    pos_ref,
+    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+    kc_in, vc_in, wo_ref, swo_ref,
+    out_ref, kc_out, vc_out,
+    kv_win, kblk, vblk, sem,
+    *, heads: int, kv_heads: int, head_dim: int, bs: int, eps: float,
+    m: int, win: int, seq: int,
+):
+    """M-row decode step: rows occupy positions pos..pos+m-1, attend the
+    prior cache (idx < pos) plus each other causally (from registers).
+    The speculative-verify workhorse — one weight stream serves all M
+    rows, same as the reference insight that makes drafts nearly free."""
+    pos = pos_ref[0]
+    half = head_dim // 2
+    dtype = x_ref.dtype
+    group = heads // kv_heads
+    scale = 1.0 / (head_dim ** 0.5)
+
+    # --- projections --------------------------------------------------------
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [M, D]
+    qkv = jax.lax.dot(
+        h, wqkv_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    ) * sqkv_ref[...].astype(jnp.float32) + bqkv_ref[...].astype(jnp.float32)
+    qf = qkv[:, : heads * head_dim].reshape(m * heads, head_dim)
+    kf = qkv[:, heads * head_dim : (heads + kv_heads) * head_dim].reshape(
+        m * kv_heads, head_dim
+    )
+    vf = qkv[:, (heads + kv_heads) * head_dim :].reshape(
+        m * kv_heads, head_dim
+    )
+
+    cos_m = cos_ref[...].astype(jnp.float32)  # [M, hd] per-row tables
+    sin_m = sin_ref[...].astype(jnp.float32)
+
+    def _expand(t, reps):  # [M, hd] -> [M*reps, hd], row-major per chunk row
+        return jnp.broadcast_to(
+            t[:, None, :], (m, reps, head_dim)
+        ).reshape(m * reps, head_dim)
+
+    q = _rotate(qf, _expand(cos_m, heads), _expand(sin_m, heads), half)
+    k = _rotate(kf, _expand(cos_m, kv_heads), _expand(sin_m, kv_heads), half)
+    k_m = k.reshape(m, kv_heads, head_dim)
+    v_m = vf.reshape(m, kv_heads, head_dim)
+
+    # --- cache window write (rows pos..pos+m-1, overlapped) -----------------
+    start = pl.multiple_of(
+        jnp.minimum(pos // 8 * 8, seq - win), 8
+    )
+    offs = pos - start
+    win_iota = jax.lax.broadcasted_iota(
+        jnp.int32, (kv_heads, win, head_dim), 1
+    )
+    krd = pltpu.make_async_copy(
+        kc_out.at[:, pl.ds(start, win), :], kv_win.at[0], sem.at[0]
+    )
+    vrd = pltpu.make_async_copy(
+        vc_out.at[:, pl.ds(start, win), :], kv_win.at[1], sem.at[1]
+    )
+    krd.start()
+    vrd.start()
+    krd.wait()
+    vrd.wait()
+    for i in range(m):
+        sel = win_iota == offs + i
+        kv_win[0] = jnp.where(
+            sel, k_m[i][:, None, :].astype(kv_win.dtype), kv_win[0]
+        )
+        kv_win[1] = jnp.where(
+            sel, v_m[i][:, None, :].astype(kv_win.dtype), kv_win[1]
+        )
+    kwr = pltpu.make_async_copy(
+        kv_win.at[0], kc_out.at[:, pl.ds(start, win), :], sem.at[0]
+    )
+    vwr = pltpu.make_async_copy(
+        kv_win.at[1], vc_out.at[:, pl.ds(start, win), :], sem.at[1]
+    )
+    kwr.start()
+    vwr.start()
+
+    # --- flash sweep over the prior cache (idx < pos, all rows) -------------
+    nblocks = (pos + bs - 1) // bs
+    rows = m * group  # per kv head
+
+    def body(b, carry):
+        m_run, l_run, acc = carry  # [KV*rows, 1], [KV*rows, 1], [KV*rows, hd]
+        kcp = pltpu.make_async_copy(
+            kc_out.at[:, pl.ds(b * bs, bs), :], kblk, sem.at[2]
+        )
+        vcp = pltpu.make_async_copy(
+            vc_out.at[:, pl.ds(b * bs, bs), :], vblk, sem.at[3]
+        )
+        kcp.start()
+        vcp.start()
+        kcp.wait()
+        vcp.wait()
+        live = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1) + b * bs
+        ) < pos
+        q4 = q.reshape(m, heads, head_dim)
+        outs = []
+        for g in range(kv_heads):
+            q_g = q4[:, g * group : (g + 1) * group, :].reshape(
+                rows, head_dim
+            )
+            s_g = jax.lax.dot_general(
+                q_g.astype(dtype), kblk[g].astype(dtype),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [rows, bs]
+            outs.append(jnp.where(live, s_g, -jnp.inf))
+        s = jnp.concatenate(outs, axis=0)  # [KV*rows, bs]
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = []
+        for g in range(kv_heads):
+            pv.append(
+                jax.lax.dot(
+                    p[g * rows : (g + 1) * rows].astype(dtype),
+                    vblk[g].astype(dtype),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        acc_new = acc * alpha + jnp.concatenate(pv, axis=0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((kv_heads * rows, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((kv_heads * rows, 1), jnp.float32)
+    a0 = jnp.zeros((kv_heads * rows, head_dim), jnp.float32)
+    m_fin, l_fin, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, a0))
+
+    # --- within-chunk causal attention from registers -----------------------
+    q4 = q.reshape(m, heads, head_dim)
+    causal = (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, m), 0) // group
+        >= jax.lax.broadcasted_iota(jnp.int32, (rows, m), 1)
+    )
+    s_parts = []
+    for g in range(kv_heads):
+        q_g = q4[:, g * group : (g + 1) * group, :].reshape(rows, head_dim)
+        s_cc = jax.lax.dot_general(
+            q_g.astype(dtype), k_m[:, g, :].astype(dtype),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [rows, m]
+        s_parts.append(jnp.where(causal, s_cc, -jnp.inf))
+    s_cc = jnp.concatenate(s_parts, axis=0)  # [KV*rows, m]
+    m2 = jnp.maximum(m_fin, jnp.max(s_cc, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_fin - m2)
+    p_cc = jnp.exp(s_cc - m2)
+    l2 = l_fin * alpha + jnp.sum(p_cc, axis=-1, keepdims=True)
+    pv = []
+    for g in range(kv_heads):
+        pv.append(
+            jax.lax.dot(
+                p_cc[g * rows : (g + 1) * rows].astype(dtype),
+                v_m[:, g, :].astype(dtype),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    acc = acc * alpha + jnp.concatenate(pv, axis=0)
+    attn = acc / l2  # [KV*rows, hd], rows ordered (g, i, gg)
+
+    attn = (
+        attn.reshape(kv_heads, m, group, head_dim)
+        .transpose(1, 0, 2, 3)
+        .reshape(m, heads * head_dim)
+    )
+    o = jax.lax.dot(
+        attn.astype(dtype), wo_ref[...].astype(dtype),
+        preferred_element_type=jnp.float32,
+    ) * swo_ref[...].astype(jnp.float32)
+    out_ref[...] = (x_ref[...].astype(jnp.float32) + o).astype(out_ref.dtype)
+    kwr.wait()
+    vwr.wait()
+
+
+@functools.partial(
+    jax.jit, static_argnames=("heads", "kv_heads", "head_dim", "eps")
+)
+def attention_chunk_step(
+    x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_cache, v_cache,
+    wo, swo, position, *, heads: int, kv_heads: int, head_dim: int,
+    eps: float = 1e-6,
+):
+    """M-row fused attention sublayer (speculative verify).
+
+    x: [M, D] — rows are the chunk tokens at positions
+    ``position..position+M-1``; cos_rows/sin_rows: [M, hd] per-row rope
+    tables (rope_rows with a length). Caller must guarantee
+    ``position + M <= seq`` (the speculation headroom contract).
+    Returns (x_out [M, D], k_cache, v_cache) with the caches updated in
+    place at all M rows.
+    """
+    m, d = x.shape
+    seq = k_cache.shape[1]
+    bs = min(512, seq)
+    assert seq % bs == 0, (seq, bs)
+    win = (7 + m + 7) // 8 * 8  # aligned row window covering all M rows
+    assert win <= seq, (win, seq)
+    n_qkv = wqkv.shape[1]
+    kernel = functools.partial(
+        _attn_chunk_kernel, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, bs=bs, eps=eps, m=m, win=win, seq=seq,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # norm_w
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
+            pl.BlockSpec(memory_space=pl.ANY),      # k_cache (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),      # v_cache (HBM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, kv_heads, win, head_dim), k_cache.dtype),
+            pltpu.VMEM((kv_heads, bs, head_dim), k_cache.dtype),
+            pltpu.VMEM((kv_heads, bs, head_dim), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((m, d), x.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        input_output_aliases={8: 1, 9: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        jnp.asarray([position], jnp.int32).reshape(1),
+        x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
+        cos_rows, sin_rows, k_cache, v_cache, wo, swo,
+    )
+
+
+# ---------------------------------------------------------------------------
 # MLP block
 # ---------------------------------------------------------------------------
 
@@ -352,9 +612,11 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
     """Fused SwiGLU decode sublayer: one grid sweep over ffn tiles.
 
     w_gateup: int8 [D, 2F] (gate | up concatenated — quantize_tree
-    layout); w_down: int8 [F, D]. Returns x + down(silu(gate)·up).
+    layout); w_down: int8 [F, D]. x: [M, D] — M = 1 for vanilla decode,
+    k+1 for speculative verify (the weight stream serves all rows).
+    Returns x + down(silu(gate)·up).
     """
-    d = x.shape[-1]
+    mrows, d = x.shape
     f = w_down.shape[0]
     bf = _pick_bf(f)
     nf = f // bf
@@ -363,7 +625,7 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
         kernel,
         grid=(nf,),
         in_specs=[
-            pl.BlockSpec((1, d), lambda i: (0, 0)),          # x
+            pl.BlockSpec((mrows, d), lambda i: (0, 0)),       # x
             pl.BlockSpec((1, d), lambda i: (0, 0)),          # norm_w
             pl.BlockSpec((d, bf), lambda i: (0, i)),          # gate tile
             pl.BlockSpec((d, bf), lambda i, _nf=nf: (0, _nf + i)),  # up tile
@@ -374,9 +636,9 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
             pl.BlockSpec((bf, d), lambda i: (i, 0)),          # down tile
             pl.BlockSpec((1, d), lambda i: (0, 0)),           # down scale
         ],
-        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((1, d), x.dtype),
-        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        out_specs=pl.BlockSpec((mrows, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((mrows, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((mrows, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
@@ -483,12 +745,13 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
 # ---------------------------------------------------------------------------
 
 
-def rope_rows(cos_table, sin_table, position):
-    """Gather the rope row at ``position`` and expand to the kernel's
-    full-width layout: cos_full = [cos, cos], sin_signed = [-sin, sin]
-    (see _rotate). Tables: [S, hd/2]. Returns two [1, hd] f32 rows."""
-    cos = jax.lax.dynamic_slice_in_dim(cos_table, position, 1, 0)
-    sin = jax.lax.dynamic_slice_in_dim(sin_table, position, 1, 0)
+def rope_rows(cos_table, sin_table, position, length: int = 1):
+    """Gather ``length`` rope rows starting at ``position`` and expand
+    to the kernel's full-width layout: cos_full = [cos, cos],
+    sin_signed = [-sin, sin] (see _rotate). Tables: [S, hd/2]. Returns
+    two [length, hd] f32 arrays."""
+    cos = jax.lax.dynamic_slice_in_dim(cos_table, position, length, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_table, position, length, 0)
     return (
         jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32),
         jnp.concatenate([-sin, sin], axis=-1).astype(jnp.float32),

@@ -416,3 +416,70 @@ def test_fused_decode_generate_matches_vanilla(monkeypatch):
     monkeypatch.setenv("DORA_FUSED_DECODE", "1")
     fused = np.asarray(vlm.generate(qparams, cfg, image, prompt, 8))
     np.testing.assert_array_equal(vanilla, fused)
+
+
+def test_fused_chunk_attention_matches_dense():
+    """attention_chunk_step (M-row speculative verify) matches the dense
+    chunk-over-cache reference: causal within the chunk, prior cache
+    visible to all rows, all M cache rows written in place."""
+    from dora_tpu.ops.decode_block import attention_chunk_step, rope_rows
+    from dora_tpu.ops.int8_matmul import dequantize, quantize_int8
+
+    rng = np.random.default_rng(3)
+    D, H, KV, HD, S, M = 64, 4, 2, 16, 64, 5
+    pos = 9
+    x = jnp.asarray(rng.standard_normal((M, D)), jnp.float32)
+    nw = jnp.asarray(rng.standard_normal(D), jnp.float32)
+    wqkv = quantize_int8(
+        jnp.asarray(rng.standard_normal((D, (H + 2 * KV) * HD)), jnp.float32)
+    )
+    wo = quantize_int8(jnp.asarray(rng.standard_normal((H * HD, D)), jnp.float32))
+    bqkv = jnp.asarray(rng.standard_normal((H + 2 * KV) * HD), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((KV, S, HD)), jnp.float32) * 0.1
+    vc = jnp.asarray(rng.standard_normal((KV, S, HD)), jnp.float32) * 0.1
+    cos_t, sin_t = L.rope_table(S, HD)
+    cosr, sinr = rope_rows(cos_t, sin_t, pos, M)
+
+    xo, kc2, vc2 = attention_chunk_step(
+        x, nw, wqkv["int8"], wqkv["scale"], bqkv, cosr, sinr, kc, vc,
+        wo["int8"], wo["scale"], pos, heads=H, kv_heads=KV, head_dim=HD,
+    )
+
+    h = L.rms_norm(x, nw)
+    qkv = h @ dequantize(wqkv) + bqkv
+    q, k, v = jnp.split(qkv, [H * HD, (H + KV) * HD], axis=-1)
+    q = q.reshape(1, M, H, HD).transpose(0, 2, 1, 3)
+    k = k.reshape(1, M, KV, HD).transpose(0, 2, 1, 3)
+    v = v.reshape(1, M, KV, HD).transpose(0, 2, 1, 3)
+    posarr = (pos + jnp.arange(M))[None]
+    q = L.apply_rope(q, cos_t, sin_t, posarr)
+    k = L.apply_rope(k, cos_t, sin_t, posarr)
+    kfull = jax.lax.dynamic_update_slice(kc[None], k, (0, 0, pos, 0))
+    vfull = jax.lax.dynamic_update_slice(vc[None], v, (0, 0, pos, 0))
+    kr = jnp.repeat(kfull, H // KV, axis=1)
+    vr = jnp.repeat(vfull, H // KV, axis=1)
+    mask = jnp.arange(S)[None, None, None, :] <= posarr[0][None, None, :, None]
+    out = L.attention(q, kr, vr, mask)
+    ref = x + out.transpose(0, 2, 1, 3).reshape(M, H * HD) @ dequantize(wo)
+    np.testing.assert_allclose(np.asarray(xo), np.asarray(ref), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(kc2), np.asarray(kfull[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vc2), np.asarray(vfull[0]), atol=1e-5)
+
+
+def test_speculative_fused_matches_fused_vanilla():
+    """On int8-quantized params both speculation (fused M-row chunk
+    verify) and vanilla generate ride the kernel tier — tokens must
+    agree exactly, in fewer passes."""
+    from dora_tpu.models import vlm
+
+    cfg = vlm.VLMConfig.tiny()
+    params = vlm.quantize_decode(vlm.init_params(jax.random.PRNGKey(0), cfg))
+    assert vlm.fused_decode_ready(params)
+    image = jax.random.uniform(
+        jax.random.PRNGKey(1), (1, cfg.image_size, cfg.image_size, 3)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0, cfg.vocab)
+    vanilla = np.asarray(vlm.generate(params, cfg, image, prompt, 16))
+    spec, passes = vlm.generate_speculative(params, cfg, image, prompt, 16)
+    np.testing.assert_array_equal(vanilla, np.asarray(spec))
+    assert int(passes) < 16
