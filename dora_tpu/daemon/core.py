@@ -128,6 +128,12 @@ class DataflowState:
     #: node id -> set when its control-channel connection has fully drained;
     #: exit handling waits on this so in-flight SendMessages are not lost
     control_done: dict[str, asyncio.Event] = field(default_factory=dict)
+    #: peer-to-peer: node -> {input_id: shmem channel name} announced
+    #: pre-barrier (the announcement marks sender capability too)
+    p2p_listeners: dict[str, dict[str, str]] = field(default_factory=dict)
+    #: edges assigned p2p at barrier release; send_out skips these
+    #: (sender, output, receiver, input)
+    p2p_edges: set = field(default_factory=set)
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -368,9 +374,68 @@ class Daemon:
     ) -> None:
         df.barrier_error = error
         df.barrier_failed_node = failed_node
+        if error is None:
+            self._compute_p2p(df)
         df.started.set()
         if error is None:
             self._start_timers(df)
+
+    def _compute_p2p(self, df: DataflowState) -> None:
+        """Assign peer-to-peer edges (TPU-build extension): an edge goes
+        direct when both endpoints are local, both announced (python
+        clients that will serve/query the channels), the receiver serves
+        that input, and the output is produced by the node itself (a
+        send_stdout_as output is published by the daemon's stdout pump,
+        which must keep routing it). Assigned edges are skipped by
+        send_out — the sender publishes into the receiver's channel."""
+        import os
+
+        if os.environ.get("DORA_P2P", "1") in ("", "0"):
+            return
+        for oid, targets in df.mappings.items():
+            sender = str(oid.node)
+            if sender not in df.local_nodes or sender not in df.p2p_listeners:
+                continue
+            node = df.descriptor.node(sender)
+            if node.send_stdout_as == str(oid.output):
+                continue
+            for target in targets:
+                rnode = str(target.node)
+                listeners = df.p2p_listeners.get(rnode)
+                if (
+                    rnode in df.local_nodes
+                    and listeners is not None
+                    and str(target.input) in listeners
+                ):
+                    df.p2p_edges.add(
+                        (sender, str(oid.output), rnode, str(target.input))
+                    )
+
+    def _p2p_edges_reply(self, df: DataflowState, node_id: str) -> Any:
+        outputs: dict[str, Any] = {}
+        for oid, targets in df.mappings.items():
+            if str(oid.node) != node_id:
+                continue
+            edges = []
+            daemon_route = False
+            for target in targets:
+                rnode = str(target.node)
+                key = (node_id, str(oid.output), rnode, str(target.input))
+                if key in df.p2p_edges:
+                    edges.append(
+                        d2n.P2PEdge(
+                            channel=df.p2p_listeners[rnode][str(target.input)],
+                            input_id=str(target.input),
+                            receiver=rnode,
+                        )
+                    )
+                else:
+                    daemon_route = True
+            if edges:
+                outputs[str(oid.output)] = d2n.P2POutput(
+                    edges=edges, daemon_route=daemon_route
+                )
+        return d2n.P2PEdgesReply(outputs=outputs)
 
     def release_barrier(self, df: DataflowState) -> None:
         """Coordinator broadcast AllNodesReady: release the start barrier."""
@@ -449,6 +514,8 @@ class Daemon:
         remote_machines: set[str] = set()
         for target in receivers:
             rnode = str(target.node)
+            if (sender, output_id, rnode, str(target.input)) in df.p2p_edges:
+                continue  # the sender published this edge peer-to-peer
             if rnode in df.local_nodes:
                 queue = df.queues.get(rnode)
                 open_inputs = df.open_inputs.get(rnode, set())
@@ -728,6 +795,18 @@ class Daemon:
         # safe against this deferred path).
         for conn in df.shmem_conns:
             conn.close()
+        # Safety net: unlink announced p2p edge channels a SIGKILLed node
+        # may have leaked (nodes normally unlink their own on close).
+        from dora_tpu.native import unlink_region
+
+        for listeners in df.p2p_listeners.values():
+            for name in listeners.values():
+                for victim in (name, name + "-a"):  # data + ack channels
+                    try:
+                        unlink_region(victim)
+                    except Exception:
+                        pass
+        df.p2p_listeners.clear()
         result = DataflowResult(
             uuid=df.id,
             node_results={
@@ -882,6 +961,11 @@ class Daemon:
                 self.send_out(df, node_id, msg.output_id, msg.metadata, msg.data)
             elif isinstance(msg, n2d.ReportDropTokens):
                 self.ack_tokens(df, node_id, msg.drop_tokens)
+            elif isinstance(msg, n2d.P2PAnnounce):
+                df.p2p_listeners[node_id] = dict(msg.listeners)
+                await self._reply(conn, d2n.ReplyResult())
+            elif isinstance(msg, n2d.P2PEdgesRequest):
+                await self._reply(conn, self._p2p_edges_reply(df, node_id))
             elif isinstance(msg, n2d.CloseOutputs):
                 self.close_outputs(df, node_id, msg.outputs)
                 await self._reply(conn, d2n.ReplyResult())

@@ -41,9 +41,39 @@ class NodeConfigReply:
 @message
 class DropEvents:
     """Reply to NextDropEvents: drop tokens whose shared-memory regions are
-    free for the owning node to reuse (empty list only on stream close)."""
+    free for the owning node to reuse (empty list only on stream close).
+    Also the per-message reply on peer-to-peer edge channels, carrying the
+    receiver-side acks accumulated since the last exchange."""
 
     drop_tokens: list[str]
+
+
+@message
+class P2PEdge:
+    """One peer-to-peer assignment for a sender output: publish straight
+    into ``channel`` (the receiver's pre-created shmem server) as input
+    ``input_id`` of ``receiver``."""
+
+    channel: str
+    input_id: str
+    receiver: str
+
+
+@message
+class P2POutput:
+    """All p2p edges of one output, plus whether a daemon SendMessage is
+    still required (non-p2p local receivers, or remote machines)."""
+
+    edges: list[Any]  # list[P2PEdge]
+    daemon_route: bool
+
+
+@message
+class P2PEdgesReply:
+    """Reply to P2PEdgesRequest: output id -> P2POutput. Outputs not in
+    the map route entirely through the daemon."""
+
+    outputs: dict[str, Any]  # output_id -> P2POutput
 
 
 # ---------------------------------------------------------------------------

@@ -99,5 +99,25 @@ class NodeConfigRequest:
     node_id: str
 
 
+@message
+class P2PAnnounce:
+    """Peer-to-peer capability announcement (control channel, before
+    Subscribe): ``listeners`` maps each of the node's input ids to a
+    shmem channel name the node is ALREADY serving — announcing after
+    creation means a sender can never race an unopened channel. The
+    announcement itself marks the node p2p-capable as a sender. At
+    barrier release the daemon pairs capable endpoints per edge and
+    stops routing those edges itself (TPU-build extension — the
+    reference routes every message through the daemon)."""
+
+    listeners: dict[str, str]
+
+
+@message
+class P2PEdgesRequest:
+    """Post-barrier query (control channel): which of my outputs go
+    peer-to-peer, and where. Reply: daemon_to_node.P2PEdgesReply."""
+
+
 def expects_reply(request: Any) -> bool:
     return not isinstance(request, (SendMessage, ReportDropTokens))

@@ -114,6 +114,30 @@ class Node:
         self._regions_in_use: dict[str, ShmemRegion] = {}  # token -> region
         self._regions_free: list[ShmemRegion] = []
         self._finished_unreported: list[str] = []
+        #: token -> outstanding ack count (p2p fan-out; default 1)
+        self._token_refs: dict[str, int] = {}
+        #: receiver side: p2p-delivered token -> its edge server
+        self._p2p_token_routes: dict[str, Any] = {}
+
+        # Peer-to-peer edge data plane (node/p2p.py): create the edge
+        # channel servers and announce them BEFORE subscribing, so the
+        # daemon can pair edges at the barrier. Dynamic nodes attach
+        # after the barrier and keep the daemon path.
+        self._p2p = None
+        if not config.dynamic and os.environ.get("DORA_P2P", "1") not in (
+            "", "0"
+        ):
+            try:
+                from dora_tpu.node.p2p import P2PEndpoint
+
+                self._p2p = P2PEndpoint(self)
+                self._control.request_ok(
+                    n2d.P2PAnnounce(listeners=self._p2p.listeners)
+                )
+            except Exception:
+                if self._p2p is not None:
+                    self._p2p.close()
+                self._p2p = None
 
         drop_channel = DaemonChannel.connect(
             comm, n2d.CHANNEL_DROP, config.dataflow_id, config.node_id, self._clock
@@ -137,6 +161,16 @@ class Node:
         # Blocks until every node of the dataflow subscribed (start barrier).
         events_channel.request_ok(n2d.Subscribe())
         self._events = EventStream(events_channel, on_ack=self._queue_ack)
+        if self._p2p is not None:
+            # Post-barrier: start serving inbound edges and learn which
+            # outputs publish peer-to-peer.
+            self._p2p.start(self._events)
+            try:
+                reply = self._control.request(n2d.P2PEdgesRequest())
+                if isinstance(reply, d2n.P2PEdgesReply):
+                    self._p2p.set_outbound(reply)
+            except Exception:
+                pass  # daemon predates p2p: everything routes normally
 
         self._closed = False
 
@@ -252,11 +286,22 @@ class Node:
                 type_info = TypeInfo(encoding=ENCODING_ARROW_IPC, len=len(payload))
                 message_data = InlineData(data=payload)
 
+        self._publish(
+            output_id,
+            Metadata(type_info=type_info, parameters=params),
+            message_data,
+        )
+
+    def _publish(self, output_id: str, metadata: Metadata, data: Any) -> None:
+        """Route one output: peer-to-peer edges first (direct shmem
+        exchange, ~32 µs), then the daemon SendMessage only when some
+        receiver still needs it (non-p2p local, remote, or none)."""
+        if self._p2p is not None:
+            if not self._p2p.publish(output_id, metadata, data):
+                return
         self._control.request(
             n2d.SendMessage(
-                output_id=output_id,
-                metadata=Metadata(type_info=type_info, parameters=params),
-                data=message_data,
+                output_id=output_id, metadata=metadata, data=data
             )
         )
 
@@ -295,15 +340,13 @@ class Node:
             )
         else:
             message_data = InlineData(data=bytes(sample._inline[:length]))
-        self._control.request(
-            n2d.SendMessage(
-                output_id=output_id,
-                metadata=Metadata(
-                    type_info=TypeInfo(encoding=encoding, len=length),
-                    parameters=dict(metadata or {}),
-                ),
-                data=message_data,
-            )
+        self._publish(
+            output_id,
+            Metadata(
+                type_info=TypeInfo(encoding=encoding, len=length),
+                parameters=dict(metadata or {}),
+            ),
+            message_data,
         )
 
     def _pack_payload_raw(self, raw: bytes) -> Any:
@@ -335,9 +378,25 @@ class Node:
         return region, token
 
     def _queue_ack(self, token: str) -> None:
+        # p2p-delivered tokens ack straight back over their edge channel
+        # (the sender owns the region; the daemon never saw the token).
+        edge = self._p2p_token_routes.pop(token, None)
+        if edge is not None:
+            edge.queue_ack(token)
+            return
         with self._ack_cond:
             self._pending_acks.append(token)
             self._ack_cond.notify()
+
+    def _register_p2p_token(self, token: str, edge: Any) -> None:
+        self._p2p_token_routes[token] = edge
+
+    def _set_token_refs(self, token: str, refs: int) -> None:
+        """Expected ack count before ``token``'s region can be reused
+        (p2p fan-out: one per direct receiver, plus the daemon's)."""
+        with self._regions_lock:
+            if refs > 1:
+                self._token_refs[token] = refs
 
     def _ack_loop(self) -> None:
         while True:
@@ -355,6 +414,11 @@ class Node:
     def _reclaim_regions(self, tokens: list[str]) -> None:
         with self._regions_lock:
             for token in tokens:
+                refs = self._token_refs.get(token)
+                if refs is not None and refs > 1:
+                    self._token_refs[token] = refs - 1
+                    continue
+                self._token_refs.pop(token, None)
                 region = self._regions_in_use.pop(token, None)
                 if region is None:
                     continue
@@ -397,12 +461,20 @@ class Node:
             self._control.request_ok(n2d.OutputsDone())
         except Exception:
             pass
+        if self._p2p is not None:
+            self._p2p.flush_acks()  # bring home receiver-side p2p acks
         deadline = time.monotonic() + DROP_TOKEN_WAIT_S
+        last_flush = time.monotonic()
         while time.monotonic() < deadline:
             with self._regions_lock:
                 if not self._regions_in_use:
                     break
+            if self._p2p is not None and time.monotonic() - last_flush > 0.5:
+                self._p2p.flush_acks()
+                last_flush = time.monotonic()
             time.sleep(0.05)
+        if self._p2p is not None:
+            self._p2p.close()
         self._drop_stream.close()
         self._events.close()
         try:

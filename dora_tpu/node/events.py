@@ -91,6 +91,12 @@ class EventStream:
     def __init__(self, channel, on_ack=None, max_queue: int | None = None):
         self._channel = channel
         self._on_ack = on_ack
+        #: optional callable run before the end-of-stream sentinel is
+        #: queued (the p2p endpoint drains edge backlogs here so direct
+        #: events cannot be overtaken by the daemon's AllInputsClosed)
+        self.pre_end = None
+        #: region cache is shared with p2p edge threads
+        self._regions_guard = threading.Lock()
         if max_queue is None:
             max_queue = self.DEFAULT_MAX_QUEUE
         self._queue: queue_mod.Queue = queue_mod.Queue(max_queue)
@@ -229,6 +235,11 @@ class EventStream:
             if not self._closed.is_set():
                 self._put(Event(type="ERROR", error=str(e)))
         finally:
+            if self.pre_end is not None:
+                try:
+                    self.pre_end()
+                except Exception:
+                    pass
             self._eos.set()  # no further real events after this point
             # The end-of-stream sentinel must land (recv blocks without
             # it); retry around a full buffer unless the consumer closed.
@@ -289,10 +300,11 @@ class EventStream:
                 return ipc_deserialize(raw), None
             return raw, None
         assert isinstance(data, SharedMemoryData)
-        region = self._regions.get(data.shmem_id)
-        if region is None:
-            region = ShmemRegion.open(data.shmem_id)
-            self._regions[data.shmem_id] = region
+        with self._regions_guard:  # cache shared with p2p edge threads
+            region = self._regions.get(data.shmem_id)
+            if region is None:
+                region = ShmemRegion.open(data.shmem_id)
+                self._regions[data.shmem_id] = region
         view = memoryview(region)[: data.len]
         if encoding == ENCODING_ARROW_IPC:
             # The arrays hold the memoryview via pyarrow's foreign buffer,

@@ -1,0 +1,206 @@
+"""Peer-to-peer edge data plane (node/p2p.py + daemon assignment).
+
+The daemon stays the control plane; eligible local python edges publish
+straight into per-sender shmem channels. These tests pin the contracts
+the implementation must keep: daemon-skip without double delivery,
+cross-input ordering from one sender, queue_size drop-oldest, full
+delivery at full speed, and the DORA_P2P=0 fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+import yaml
+
+from dora_tpu.daemon import run_dataflow
+
+
+def _run(tmp_path, nodes, timeout_s=90, env=None):
+    spec = {"nodes": nodes, "communication": {"local": "shmem"}}
+    df = tmp_path / "flow.yml"
+    df.write_text(yaml.safe_dump(spec))
+    import os
+
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        result = run_dataflow(df, local_comm="shmem", timeout_s=timeout_s)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert result.is_ok(), result.errors()
+    return result
+
+
+SENDER_BURST = textwrap.dedent("""
+    from dora_tpu.node import Node
+    with Node() as node:
+        for i in range(30):
+            sample = node.allocate_sample(8192)
+            sample.view[:8192] = bytes([i % 256]) * 8192
+            node.send_sample("data", sample, 8192, metadata={"seq": i})
+""")
+
+
+def test_p2p_full_speed_no_loss(tmp_path):
+    """30 zero-copy messages at full speed all arrive, in order (the
+    one-outstanding-frame flow control is the backpressure)."""
+    (tmp_path / "s.py").write_text(SENDER_BURST)
+    (tmp_path / "r.py").write_text(textwrap.dedent("""
+        import json
+        from dora_tpu.node import Node
+        seqs = []
+        node = Node()
+        assert node._p2p is not None
+        for event in node:
+            if event["type"] == "INPUT":
+                seqs.append(event["metadata"]["seq"])
+        node.close()
+        open("seqs.json", "w").write(json.dumps(seqs))
+    """))
+    _run(tmp_path, [
+        {"id": "s", "path": "s.py", "outputs": ["data"]},
+        {"id": "r", "path": "r.py",
+         "inputs": {"data": {"source": "s/data", "queue_size": 100}}},
+    ])
+    seqs = json.loads((tmp_path / "seqs.json").read_text())
+    assert seqs == list(range(30)), seqs
+
+
+def test_p2p_assignment_and_daemon_skip(tmp_path):
+    """The sender learns its p2p edges; with every receiver direct, the
+    daemon route is off entirely (no double delivery possible — the
+    receiver's exact-count assert doubles as the proof)."""
+    (tmp_path / "s.py").write_text(textwrap.dedent("""
+        import json
+        from dora_tpu.node import Node
+        with Node() as node:
+            out = {
+                k: {"edges": len(v.edges), "daemon_route": v.daemon_route}
+                for k, v in node._p2p.outbound.items()
+            }
+            open("outbound.json", "w").write(json.dumps(out))
+            for i in range(5):
+                node.send_output("data", b"x" * 100, {"seq": i})
+    """))
+    (tmp_path / "r.py").write_text(textwrap.dedent("""
+        import json
+        from dora_tpu.node import Node
+        n = 0
+        node = Node()
+        for event in node:
+            if event["type"] == "INPUT":
+                n += 1
+        node.close()
+        open("count.json", "w").write(json.dumps(n))
+    """))
+    _run(tmp_path, [
+        {"id": "s", "path": "s.py", "outputs": ["data"]},
+        {"id": "r", "path": "r.py",
+         "inputs": {"data": {"source": "s/data", "queue_size": 100}}},
+    ])
+    outbound = json.loads((tmp_path / "outbound.json").read_text())
+    assert outbound == {"data": {"edges": 1, "daemon_route": False}}
+    assert json.loads((tmp_path / "count.json").read_text()) == 5
+
+
+def test_p2p_cross_input_ordering(tmp_path):
+    """Two inputs fed by ONE sender share a channel: a phase marker sent
+    after N data messages must arrive after all of them (the daemon's
+    single-queue ordering contract)."""
+    (tmp_path / "s.py").write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+        with Node() as node:
+            for i in range(15):
+                node.send_output("data", b"d" * 6000, {"seq": i})
+            node.send_output("marker", b"m", {})
+    """))
+    (tmp_path / "r.py").write_text(textwrap.dedent("""
+        import json
+        from dora_tpu.node import Node
+        order = []
+        node = Node()
+        for event in node:
+            if event["type"] == "INPUT":
+                order.append(event["id"])
+        node.close()
+        open("order.json", "w").write(json.dumps(order))
+    """))
+    _run(tmp_path, [
+        {"id": "s", "path": "s.py", "outputs": ["data", "marker"]},
+        {"id": "r", "path": "r.py", "inputs": {
+            "data": {"source": "s/data", "queue_size": 100},
+            "marker": {"source": "s/marker", "queue_size": 10},
+        }},
+    ])
+    order = json.loads((tmp_path / "order.json").read_text())
+    assert order == ["data"] * 15 + ["marker"], order
+
+
+def test_p2p_queue_size_drop_oldest(tmp_path):
+    """A slow consumer behind queue_size 2 sees the FRESHEST events
+    (drop-oldest), never an unbounded backlog."""
+    (tmp_path / "s.py").write_text(textwrap.dedent("""
+        import time
+        from dora_tpu.node import Node
+        with Node() as node:
+            for i in range(40):
+                node.send_output("data", b"d" * 5000, {"seq": i})
+                time.sleep(0.005)
+    """))
+    (tmp_path / "r.py").write_text(textwrap.dedent("""
+        import json, time
+        from dora_tpu.node import Node
+        seqs = []
+        node = Node()
+        for event in node:
+            if event["type"] == "INPUT":
+                seqs.append(event["metadata"]["seq"])
+                time.sleep(0.05)  # 10x slower than the producer
+        node.close()
+        open("seqs.json", "w").write(json.dumps(seqs))
+    """))
+    _run(tmp_path, [
+        {"id": "s", "path": "s.py", "outputs": ["data"]},
+        {"id": "r", "path": "r.py",
+         "inputs": {"data": {"source": "s/data", "queue_size": 2}}},
+    ], timeout_s=120)
+    seqs = json.loads((tmp_path / "seqs.json").read_text())
+    assert len(seqs) < 40, "drop-oldest never engaged"
+    assert seqs == sorted(seqs), "order violated"
+    assert seqs[-1] > 30, "the freshest events must win"
+
+
+def test_p2p_kill_switch(tmp_path):
+    """DORA_P2P=0: everything routes through the daemon, same results."""
+    (tmp_path / "s.py").write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+        with Node() as node:
+            assert node._p2p is None
+            for i in range(5):
+                node.send_output("data", b"x", {"seq": i})
+    """))
+    (tmp_path / "r.py").write_text(textwrap.dedent("""
+        import json
+        from dora_tpu.node import Node
+        seqs = []
+        node = Node()
+        for event in node:
+            if event["type"] == "INPUT":
+                seqs.append(event["metadata"]["seq"])
+        node.close()
+        open("seqs.json", "w").write(json.dumps(seqs))
+    """))
+    _run(tmp_path, [
+        {"id": "s", "path": "s.py", "outputs": ["data"]},
+        {"id": "r", "path": "r.py", "inputs": {"data": "s/data"}},
+    ], env={"DORA_P2P": "0"})
+    assert json.loads((tmp_path / "seqs.json").read_text()) == list(range(5))
