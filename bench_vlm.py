@@ -128,7 +128,8 @@ def bench_model(max_new: int = 64, prefill_iters: int = 16,
     )
     params = cast(params)
     int8 = bool(os.environ.get("DORA_INT8_DECODE"))
-    if int8:
+    int4 = bool(os.environ.get("DORA_INT4_DECODE"))
+    if int8 or int4:
         quantize = jax.jit(
             lambda p: vlm.quantize_decode(p), donate_argnums=0
         )
@@ -216,11 +217,11 @@ def bench_model(max_new: int = 64, prefill_iters: int = 16,
     # reported for completeness but ~0.3% is simply the batch-1 physics.
     # (embedding gather reads one row, not the table; lm_head is already
     # in the matmul count)
-    bytes_per_param = 1.0 if int8 else 2.0  # int8 vs bf16 resident
+    bytes_per_param = 0.5 if int4 else (1.0 if int8 else 2.0)
     lm_param_bytes = bytes_per_param * (lm_matmul_flops_per_token(cfg) / 2)
     decode_mbu = lm_param_bytes * tokens_per_s / (PEAK_HBM_GBS * 1e9)
 
-    tag = " int8" if int8 else ""
+    tag = " int4" if int4 else (" int8" if int8 else "")
     _emit("vlm-2b prefill latency", prefill_s * 1e3, "ms",
           backend=backend, prefill_tokens=prefill_tokens)
     _emit(f"vlm-2b decode{tag} throughput", tokens_per_s, "tokens/s",

@@ -65,15 +65,22 @@ def _norm(x, params, prefix: str, kind: str, eps: float):
 
 
 def matmul(x, w):
-    """``x @ w`` where ``w`` is a float array or an int8-quantized dict
-    ({"int8", "scale"[, "bf16"]} — see ops.int8_matmul). Matvec-shaped
-    quantized calls (decode) run the Pallas dequant-at-MXU-edge kernel
-    so HBM reads the int8 bytes only; larger-M calls (prefill/training,
-    MXU-bound) prefer the bf16 sidecar when the quantizer kept one."""
+    """``x @ w`` where ``w`` is a float array or a quantized dict
+    ({"int8", "scale"[, "bf16"]} — ops.int8_matmul — or
+    {"int4", "gscale"[, "bf16"]} — ops.int4). Matvec-shaped int8 calls
+    (decode) run the Pallas dequant-at-MXU-edge kernel so HBM reads the
+    int8 bytes only; larger-M calls (prefill/training, MXU-bound)
+    prefer the bf16 sidecar when the quantizer kept one. Int4 decode
+    normally rides the fused kernel tier (ops.decode_block); this
+    fallback dequantizes on the fly for any path that lands here."""
     if isinstance(w, dict):
         m = math.prod(x.shape[:-1])
         if m > 32 and "bf16" in w:
             return x @ w["bf16"].astype(x.dtype)
+        if "int4" in w:
+            from dora_tpu.ops.int4 import dequantize_int4
+
+            return x @ dequantize_int4(w, x.dtype)
         from dora_tpu.ops.int8_matmul import int8_matmul
 
         return int8_matmul(x, w["int8"], w["scale"])

@@ -115,21 +115,33 @@ def param_count(params) -> int:
 
 
 def quantize_decode(params) -> dict:
-    """Int8-quantize the decode-path weights (LM blocks + lm_head).
+    """Quantize the decode-path weights (LM blocks + lm_head).
 
     The vision tower and embedding are untouched: they run once per
     frame in prefill (compute-bound), while the LM weights stream from
-    HBM on every generated token (bandwidth-bound — the int8 payoff,
-    see ops.int8_matmul). Serving gate: DORA_INT8_DECODE=1;
-    DORA_INT8_PURE=1 additionally drops the bf16 prefill sidecar
-    (halves LM weight memory, slower prefill).
+    HBM on every generated token (bandwidth-bound — the quantization
+    payoff, see ops.int8_matmul / ops.int4). Serving gates:
+    DORA_INT8_DECODE=1 (per-channel int8); DORA_INT4_DECODE=1
+    (group-128 int4 — half the decode bytes again, fused-kernel tier
+    only); DORA_INT8_PURE=1 drops the bf16 prefill sidecar (halves LM
+    weight memory, slower prefill).
     """
     import os
 
-    from dora_tpu.ops.int8_matmul import quantize_tree
-
     keep_bf16 = not os.environ.get("DORA_INT8_PURE")
     out = dict(params)
+    if os.environ.get("DORA_INT4_DECODE"):
+        from dora_tpu.ops.int4 import quantize_tree_int4
+
+        out["blocks"] = quantize_tree_int4(
+            params["blocks"], keep_bf16=keep_bf16
+        )
+        out["lm_head"] = quantize_tree_int4(
+            {"lm_head": params["lm_head"]}, keep_bf16=keep_bf16
+        )["lm_head"]
+        return out
+    from dora_tpu.ops.int8_matmul import quantize_tree
+
     out["blocks"] = quantize_tree(params["blocks"], keep_bf16=keep_bf16)
     out["lm_head"] = quantize_tree(
         {"lm_head": params["lm_head"]}, keep_bf16=keep_bf16
@@ -256,10 +268,10 @@ def decode_step(params, cfg: VLMConfig, token, caches, position):
 
 def fused_decode_ready(params, batch: int = 1) -> bool:
     """True when the decode step can run the fused Pallas tier
-    (ops.decode_block): batch 1, int8-quantized fused layout from
+    (ops.decode_block): batch 1, a quantized fused layout from
     quantize_decode (wqkv / w_gateup / wo / w_down / lm_head all int8
-    dicts), and no output-projection biases (Qwen2/bench layout).
-    Opt-out: DORA_FUSED_DECODE=0."""
+    OR int4 dicts), and no output-projection biases (Qwen2/bench
+    layout). Opt-out: DORA_FUSED_DECODE=0."""
     import os
 
     if os.environ.get("DORA_FUSED_DECODE", "1") in ("", "0"):
@@ -272,7 +284,7 @@ def fused_decode_ready(params, batch: int = 1) -> bool:
         return False
 
     def _q(x):
-        return isinstance(x, dict) and "int8" in x
+        return isinstance(x, dict) and ("int8" in x or "int4" in x)
 
     return (
         _q(blk.get("wqkv"))
@@ -283,6 +295,13 @@ def fused_decode_ready(params, batch: int = 1) -> bool:
         and "bo" not in blk
         and "b_down" not in blk
     )
+
+
+def _qw(d: dict):
+    """Quantized dict -> (weights, scales) in the kernel layout."""
+    if "int4" in d:
+        return d["int4"], d["gscale"]
+    return d["int8"], d["scale"]
 
 
 def decode_step_fused(params, cfg: VLMConfig, token, caches, position):
@@ -320,25 +339,23 @@ def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
         bqkv = blk.get("bqkv")
         if bqkv is None:
             bqkv = jnp.zeros((n_qkv,), jnp.float32)
+        wqkv, sqkv = _qw(blk["wqkv"])
+        wo, swo = _qw(blk["wo"])
         x, kc, vc = attn(
-            x, blk["attn_norm"], blk["wqkv"]["int8"], blk["wqkv"]["scale"],
-            bqkv, cos_rows, sin_rows, kc, vc,
-            blk["wo"]["int8"], blk["wo"]["scale"], position,
+            x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+            kc, vc, wo, swo, position,
             heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
         )
         new_caches[str(i)] = {"k": kc[None], "v": vc[None]}
+        wgu, sgu = _qw(blk["w_gateup"])
+        wd, sd = _qw(blk["w_down"])
+        ffn = wd.shape[0] * (2 if "int4" in blk["w_down"] else 1)
         bgu = blk.get("b_gateup")
         if bgu is None:
-            bgu = jnp.zeros((2 * blk["w_down"]["int8"].shape[0],), jnp.float32)
-        x = DB.mlp_step(
-            x, blk["ffn_norm"], blk["w_gateup"]["int8"],
-            blk["w_gateup"]["scale"], bgu, blk["w_down"]["int8"],
-            blk["w_down"]["scale"],
-        )
-    greedy = DB.lm_head_argmax(
-        x, params["out_norm"], params["lm_head"]["int8"],
-        params["lm_head"]["scale"],
-    )
+            bgu = jnp.zeros((2 * ffn,), jnp.float32)
+        x = DB.mlp_step(x, blk["ffn_norm"], wgu, sgu, bgu, wd, sd)
+    wh, sh = _qw(params["lm_head"])
+    greedy = DB.lm_head_argmax(x, params["out_norm"], wh, sh)
     return greedy, new_caches
 
 

@@ -262,9 +262,12 @@ def make_vlm() -> JaxOperator:
 
     cfg = vlm.VLMConfig.tiny() if _size() == "tiny" else vlm.VLMConfig.bench_2b()
     params = _maybe_restore(vlm.init_params(jax.random.PRNGKey(0), cfg), "vlm")
-    if os.environ.get("DORA_INT8_DECODE"):
-        # Bandwidth lever: int8 LM weights, dequantized at the MXU edge
-        # (ops.int8_matmul). Applied after cast/restore so the stored
+    if os.environ.get("DORA_INT8_DECODE") or os.environ.get(
+        "DORA_INT4_DECODE"
+    ):
+        # Bandwidth lever: quantized LM weights, dequantized at the MXU
+        # edge (ops.int8_matmul / ops.int4 — quantize_decode picks the
+        # width from the env). Applied after cast/restore so the stored
         # float weights are the quantization source.
         params = vlm.quantize_decode(params)
     prompt_text = os.environ.get("DORA_PROMPT", "describe")

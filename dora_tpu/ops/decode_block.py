@@ -21,9 +21,11 @@ calls per layer plus one for the lm_head:
       token id.
 
 Quantization layout comes from ops.int8_matmul.quantize_tree(fuse=True):
-``wqkv``/``w_gateup`` fused int8 dicts with per-output-channel scales.
-Scales commute with the matmul (see int8_matmul), so applying them on
-the f32 accumulator is exact.
+``wqkv``/``w_gateup`` fused dicts — int8 with per-output-channel scales
+(which commute with the matmul, so applying them on the f32 accumulator
+is exact), or int4 group-packed nibbles with group scales (ops.int4,
+DORA_INT4_DECODE=1 — half the decode bytes; every kernel dispatches on
+the weight dtype).
 
 Reference parity: the reference's decode path is torch/CUDA eager
 (node-hub/dora-qwenvl/dora_qwenvl/main.py) with no fused-kernel tier;
@@ -56,6 +58,46 @@ def _rms(x_ref, w_ref, eps: float):
     return x * w_ref[...].astype(jnp.float32)
 
 
+def _wdot(x, w_ref, s, *, int4: bool):
+    """``x @ W`` for a quantized weight block, f32 accumulator.
+
+    int8 layout: w_ref [K, BN] int8, s [1, BN] per-column scale applied
+    on the accumulator (commutes exactly). int4 layout: w_ref [K/2, BN]
+    group-packed nibbles (ops.int4), s [K/GROUP, BN] group scales
+    applied per-group via a batched dot — HBM streams half the bytes of
+    int8. ``s`` is the loaded scale ARRAY (callers pass ``s_ref[...]``
+    or a gathered tile).
+    """
+    dtype = x.dtype
+    if not int4:
+        return jax.lax.dot(
+            x, w_ref[...].astype(dtype), preferred_element_type=jnp.float32
+        ) * s.astype(jnp.float32)
+    from dora_tpu.ops.int4 import unpack_grouped
+
+    k = x.shape[-1]
+    ng = s.shape[0]  # group count; group size = K // ng
+    m = x.shape[0]
+    gsz = k // ng
+    q3 = unpack_grouped(w_ref[...], ng, dtype)  # [ng, G, BN]
+    # Grouped batched dot with f32 scale application on the partials.
+    # Measured on v5e this beats folding scales into the weights
+    # (307 tok/s) — the fold pays a VPU multiply on every weight value;
+    # here the scale rides on the [ng, M, BN] partials instead. Numeric
+    # note: q is integer-exact in bf16 and scales apply in f32, so this
+    # is mathematically x @ dequantize, but its rounding differs from
+    # the bf16(q*s) weights the unfused fallback uses — exact token
+    # equality between the two is asserted on the f32 interpret path
+    # (tests), and on TPU they may differ by final-ulp logit ties.
+    x3 = x.reshape(m, ng, gsz).transpose(1, 0, 2)  # [ng, M, G]
+    parts = jax.lax.dot_general(
+        x3, q3, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [ng, M, BN]
+    scaled = parts * s.astype(jnp.float32)[:, None, :]
+    return jnp.sum(scaled, axis=0)
+
+
 def _rotate(x, cos_full, sin_signed, half: int):
     """NeoX rotary on [H, hd] rows given full-width tables:
     ``cos_full = [cos, cos]``, ``sin_signed = [-sin, sin]`` — then
@@ -81,13 +123,11 @@ def _attn_kernel(
     pos = pos_ref[0]
     half = head_dim // 2
     dtype = x_ref.dtype
+    int4 = wqkv_ref.dtype == jnp.uint8
 
     # --- projections --------------------------------------------------------
     h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [1, D]
-    qkv = jax.lax.dot(
-        h, wqkv_ref[...].astype(dtype), preferred_element_type=jnp.float32
-    )  # [1, (H+2KV)*hd]
-    qkv = qkv * sqkv_ref[...].astype(jnp.float32) + bqkv_ref[...].astype(
+    qkv = _wdot(h, wqkv_ref, sqkv_ref[...], int4=int4) + bqkv_ref[...].astype(
         jnp.float32
     )
     qkv = qkv.reshape(heads + 2 * kv_heads, head_dim)
@@ -212,11 +252,10 @@ def _attn_kernel(
     attn = (acc * alpha + w_new * v_full) / l2  # [H, hd]
 
     # --- output projection + residual ---------------------------------------
-    o = jax.lax.dot(
-        attn.reshape(1, heads * head_dim).astype(dtype),
-        wo_ref[...].astype(dtype),
-        preferred_element_type=jnp.float32,
-    ) * swo_ref[...].astype(jnp.float32)
+    o = _wdot(
+        attn.reshape(1, heads * head_dim).astype(dtype), wo_ref,
+        swo_ref[...], int4=int4,
+    )
     out_ref[...] = (x_ref[...].astype(jnp.float32) + o).astype(out_ref.dtype)
     kwr.wait()
     vwr.wait()
@@ -232,10 +271,11 @@ def attention_step(
 ):
     """One fused decode attention sublayer.
 
-    x: [1, D]; wqkv int8 [D, (H+2KV)*hd] with scale [1, ...]; caches
-    [KV, S, hd] (updated in place at ``position`` — the returned caches
-    alias the inputs); cos_full/sin_signed: [1, hd] position-gathered
-    rope rows (see vlm rope prep). Returns (x_out, k_cache, v_cache).
+    x: [1, D]; wqkv int8 [D, (H+2KV)*hd] with scale [1, ...] or int4
+    [D/2, ...] uint8 with group scales; caches [KV, S, hd] (updated in
+    place at ``position`` — the returned caches alias the inputs);
+    cos_full/sin_signed: [1, hd] position-gathered rope rows (see vlm
+    rope prep). Returns (x_out, k_cache, v_cache).
     """
     seq = k_cache.shape[1]
     bs = min(512, seq)
@@ -320,11 +360,13 @@ def _attn_chunk_kernel(
     group = heads // kv_heads
     scale = 1.0 / (head_dim ** 0.5)
 
+    int4 = wqkv_ref.dtype == jnp.uint8
+
     # --- projections --------------------------------------------------------
     h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [M, D]
-    qkv = jax.lax.dot(
-        h, wqkv_ref[...].astype(dtype), preferred_element_type=jnp.float32
-    ) * sqkv_ref[...].astype(jnp.float32) + bqkv_ref[...].astype(jnp.float32)
+    qkv = _wdot(h, wqkv_ref, sqkv_ref[...], int4=int4) + bqkv_ref[...].astype(
+        jnp.float32
+    )
     qf = qkv[:, : heads * head_dim].reshape(m * heads, head_dim)
     kf = qkv[:, heads * head_dim : (heads + kv_heads) * head_dim].reshape(
         m * kv_heads, head_dim
@@ -471,10 +513,7 @@ def _attn_chunk_kernel(
         .transpose(1, 0, 2, 3)
         .reshape(m, heads * head_dim)
     )
-    o = jax.lax.dot(
-        attn.astype(dtype), wo_ref[...].astype(dtype),
-        preferred_element_type=jnp.float32,
-    ) * swo_ref[...].astype(jnp.float32)
+    o = _wdot(attn.astype(dtype), wo_ref, swo_ref[...], int4=int4)
     out_ref[...] = (x_ref[...].astype(jnp.float32) + o).astype(out_ref.dtype)
     kwr.wait()
     vwr.wait()
@@ -563,7 +602,7 @@ def attention_chunk_step(
 
 def _mlp_kernel(
     x_ref, nw_ref, gate_ref, up_ref, sg_ref, su_ref, bg_ref, bu_ref,
-    down_ref, sd_ref, out_ref, acc_ref, *, nf: int, eps: float,
+    down_ref, sd_ref, out_ref, acc_ref, *, nf: int, eps: float, int4: bool,
 ):
     fi = pl.program_id(0)
     dtype = x_ref.dtype
@@ -573,22 +612,39 @@ def _mlp_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     h = _rms(x_ref, nw_ref, eps).astype(dtype)  # recomputed per tile: O(D)
-    g = jax.lax.dot(
-        h, gate_ref[...].astype(dtype), preferred_element_type=jnp.float32
-    ) * sg_ref[...].astype(jnp.float32) + bg_ref[...].astype(jnp.float32)
-    u = jax.lax.dot(
-        h, up_ref[...].astype(dtype), preferred_element_type=jnp.float32
-    ) * su_ref[...].astype(jnp.float32) + bu_ref[...].astype(jnp.float32)
-    a = (jax.nn.silu(g) * u).astype(dtype)  # [1, BF]
-    acc_ref[...] += jax.lax.dot(
-        a, down_ref[...].astype(dtype), preferred_element_type=jnp.float32
+    g = _wdot(h, gate_ref, sg_ref[...], int4=int4) + bg_ref[...].astype(
+        jnp.float32
     )
+    u = _wdot(h, up_ref, su_ref[...], int4=int4) + bu_ref[...].astype(jnp.float32)
+    a = (jax.nn.silu(g) * u).astype(dtype)  # [M, BF]
+    if int4:
+        # The down group scales ride in FULL (their per-tile row count
+        # is not sublane-aligned, which Mosaic block specs require);
+        # gather this tile's rows with a one-hot matmul — the only
+        # Mosaic-safe dynamic row gather.
+        sd = sd_ref[...].astype(jnp.float32)          # [F/G, D]
+        rows = sd.shape[0] // nf
+        sel = (
+            jax.lax.broadcasted_iota(jnp.int32, (rows, sd.shape[0]), 1)
+            == fi * rows
+            + jax.lax.broadcasted_iota(jnp.int32, (rows, sd.shape[0]), 0)
+        ).astype(jnp.float32)
+        sd_tile = jax.lax.dot(sel, sd, preferred_element_type=jnp.float32)
+        acc_ref[...] += _wdot(a, down_ref, sd_tile, int4=True)
+    else:
+        acc_ref[...] += jax.lax.dot(
+            a, down_ref[...].astype(dtype), preferred_element_type=jnp.float32
+        )
 
     @pl.when(fi == nf - 1)
     def _finalize():
+        acc = acc_ref[...]
+        if not int4:
+            # Per-column down scale commutes with the ffn sweep: apply
+            # once on the final accumulator.
+            acc = acc * sd_ref[...].astype(jnp.float32)
         out_ref[...] = (
-            x_ref[...].astype(jnp.float32)
-            + acc_ref[...] * sd_ref[...].astype(jnp.float32)
+            x_ref[...].astype(jnp.float32) + acc
         ).astype(out_ref.dtype)
 
 
@@ -612,29 +668,39 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
     """Fused SwiGLU decode sublayer: one grid sweep over ffn tiles.
 
     w_gateup: int8 [D, 2F] (gate | up concatenated — quantize_tree
-    layout); w_down: int8 [F, D]. x: [M, D] — M = 1 for vanilla decode,
-    k+1 for speculative verify (the weight stream serves all rows).
+    layout) with per-column scales [1, 2F], or int4-packed [D/2, 2F]
+    uint8 with group scales [D/GROUP, 2F] (ops.int4); w_down likewise
+    [F, D] / [F/2, D]. x: [M, D] — M = 1 for vanilla decode, k+1 for
+    speculative verify (the weight stream serves all rows).
     Returns x + down(silu(gate)·up).
     """
     mrows, d = x.shape
-    f = w_down.shape[0]
+    int4 = w_gateup.dtype == jnp.uint8
+    f = w_down.shape[0] * (2 if int4 else 1)
     bf = _pick_bf(f)
     nf = f // bf
-    kernel = functools.partial(_mlp_kernel, nf=nf, eps=eps)
+    kernel = functools.partial(_mlp_kernel, nf=nf, eps=eps, int4=int4)
+    if int4:
+        wrows, drows = d // 2, bf // 2  # packed row counts
+        srows = s_gateup.shape[0]       # groups over D (gate/up K dim)
+        sdrows = s_down.shape[0]        # down scales ride in full
+        assert bf % (f // s_down.shape[0]) == 0, (bf, f, s_down.shape)
+    else:
+        wrows, drows, srows, sdrows = d, bf, 1, 1
     return pl.pallas_call(
         kernel,
         grid=(nf,),
         in_specs=[
             pl.BlockSpec((mrows, d), lambda i: (0, 0)),       # x
             pl.BlockSpec((1, d), lambda i: (0, 0)),          # norm_w
-            pl.BlockSpec((d, bf), lambda i: (0, i)),          # gate tile
-            pl.BlockSpec((d, bf), lambda i, _nf=nf: (0, _nf + i)),  # up tile
-            pl.BlockSpec((1, bf), lambda i: (0, i)),          # gate scale
-            pl.BlockSpec((1, bf), lambda i, _nf=nf: (0, _nf + i)),  # up scale
+            pl.BlockSpec((wrows, bf), lambda i: (0, i)),      # gate tile
+            pl.BlockSpec((wrows, bf), lambda i, _nf=nf: (0, _nf + i)),  # up
+            pl.BlockSpec((srows, bf), lambda i: (0, i)),      # gate scale
+            pl.BlockSpec((srows, bf), lambda i, _nf=nf: (0, _nf + i)),
             pl.BlockSpec((1, bf), lambda i: (0, i)),          # gate bias
             pl.BlockSpec((1, bf), lambda i, _nf=nf: (0, _nf + i)),  # up bias
-            pl.BlockSpec((bf, d), lambda i: (i, 0)),          # down tile
-            pl.BlockSpec((1, d), lambda i: (0, 0)),           # down scale
+            pl.BlockSpec((drows, d), lambda i: (i, 0)),       # down tile
+            pl.BlockSpec((sdrows, d), lambda i: (0, 0)),  # down scale
         ],
         out_specs=pl.BlockSpec((mrows, d), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((mrows, d), x.dtype),
@@ -666,9 +732,7 @@ def _head_kernel(
     m = x_ref.shape[0]
 
     h = _rms(x_ref, nw_ref, eps).astype(dtype)
-    logits = jax.lax.dot(
-        h, w_ref[...].astype(dtype), preferred_element_type=jnp.float32
-    ) * s_ref[...].astype(jnp.float32)  # [M, BV]
+    logits = _wdot(h, w_ref, s_ref[...], int4=w_ref.dtype == jnp.uint8)  # [M, BV]
     # Padded vocab tail (if any) must never win.
     col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + vi * bv
     logits = jnp.where(col < vocab, logits, -jnp.inf)
@@ -696,13 +760,15 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
     """Greedy next-token ids straight from the kernel.
 
     x: [M, D] (M = 1 vanilla decode, k+1 speculative verify); w: int8
-    [D, V]. Streams the head by vocab tile with a running per-row
+    [D, V] or int4-packed [D/2, V] uint8 with group scales. Streams the
+    head by vocab tile with a running per-row
     argmax — no [M, V] f32 logits materialize anywhere. Returns [M]
     int32.
     """
     import os
 
     m, d = x.shape
+    int4 = w.dtype == jnp.uint8
     vocab = w.shape[1]
     # Tile sweep note (v5e, 152k vocab): 2048 keeps the int8 panel +
     # its in-register bf16 conversion inside the double-buffer budget;
@@ -717,14 +783,16 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
     kernel = functools.partial(
         _head_kernel, nv=nv, bv=bv, vocab=vocab, eps=eps
     )
+    wrows = d // 2 if int4 else d
+    srows = s.shape[0] if int4 else 1
     out = pl.pallas_call(
         kernel,
         grid=(nv,),
         in_specs=[
             pl.BlockSpec((m, d), lambda i: (0, 0)),
             pl.BlockSpec((1, d), lambda i: (0, 0)),
-            pl.BlockSpec((d, bv), lambda i: (0, i)),
-            pl.BlockSpec((1, bv), lambda i: (0, i)),
+            pl.BlockSpec((wrows, bv), lambda i: (0, i)),
+            pl.BlockSpec((srows, bv), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((m, 1), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((m, 1), jnp.int32),

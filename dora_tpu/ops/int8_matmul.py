@@ -215,12 +215,13 @@ def _fusable(params, names) -> bool:
     )
 
 
-def _fuse(params, out, w_names, b_names, w_key, b_key, keep_bf16):
+def _fuse(params, out, w_names, b_names, w_key, b_key, keep_bf16,
+          quantizer):
     """Concatenate the named projections along N into one quantized
     weight (one kernel sweep instead of len(w_names)); biases concatenate
     with zero fill for absent segments."""
     ws = [params[n] for n in w_names]
-    out[w_key] = quantize_int8(
+    out[w_key] = quantizer(
         jnp.concatenate([jnp.asarray(w) for w in ws], axis=1), keep_bf16
     )
     if any(b in params for b in b_names):
@@ -235,8 +236,8 @@ def _fuse(params, out, w_names, b_names, w_key, b_key, keep_bf16):
 
 
 def quantize_tree(params, names=DECODE_WEIGHTS, keep_bf16: bool = True,
-                  fuse: bool = True):
-    """Replace named 2-D weight leaves with int8-quantized dicts.
+                  fuse: bool = True, quantizer=quantize_int8):
+    """Replace named 2-D weight leaves with quantized dicts.
 
     Walks nested dicts; a leaf is quantized when its key is in ``names``
     and it is a rank-2 float array. Everything else is returned as-is;
@@ -246,25 +247,27 @@ def quantize_tree(params, names=DECODE_WEIGHTS, keep_bf16: bool = True,
     mlp_sublayer split after the matmul) — decode is kernel-launch-bound
     at ~100+ calls/token, so halving the call count is worth real
     tokens/s. ``keep_bf16`` rides the original weights along for the
-    MXU-bound large-M paths (see quantize_int8).
+    MXU-bound large-M paths (see quantize_int8). ``quantizer`` selects
+    the weight format — quantize_int8 (default) or ops.int4's
+    quantize_int4 — the whole fusion/recursion machinery is shared.
 
     Note: fused/quantized leaves fall outside the Megatron tp sharding
-    rules (layers.tp_rules matches leaf names) — int8 decode is a
+    rules (layers.tp_rules matches leaf names) — quantized decode is a
     single-chip serving configuration.
     """
     if not isinstance(params, dict):
         return params
-    if "int8" in params and "scale" in params:
+    if "int8" in params or "int4" in params:
         return params
     out = {}
     skip: set[str] = set()
     if fuse and {"wq", "wk", "wv"} <= names and _fusable(params, ("wq", "wk", "wv")):
         _fuse(params, out, ("wq", "wk", "wv"), ("bq", "bk", "bv"),
-              "wqkv", "bqkv", keep_bf16)
+              "wqkv", "bqkv", keep_bf16, quantizer)
         skip |= {"wq", "wk", "wv", "bq", "bk", "bv"}
     if fuse and {"w_gate", "w_up"} <= names and _fusable(params, ("w_gate", "w_up")):
         _fuse(params, out, ("w_gate", "w_up"), ("b_gate", "b_up"),
-              "w_gateup", "b_gateup", keep_bf16)
+              "w_gateup", "b_gateup", keep_bf16, quantizer)
         skip |= {"w_gate", "w_up", "b_gate", "b_up"}
     for key, value in params.items():
         if key in skip:
@@ -275,7 +278,7 @@ def quantize_tree(params, names=DECODE_WEIGHTS, keep_bf16: bool = True,
             and getattr(value, "ndim", 0) == 2
             and jnp.issubdtype(value.dtype, jnp.floating)
         ):
-            out[key] = quantize_int8(value, keep_bf16)
+            out[key] = quantizer(value, keep_bf16)
         else:
-            out[key] = quantize_tree(value, names, keep_bf16, fuse)
+            out[key] = quantize_tree(value, names, keep_bf16, fuse, quantizer)
     return out

@@ -483,3 +483,47 @@ def test_speculative_fused_matches_fused_vanilla():
     spec, passes = vlm.generate_speculative(params, cfg, image, prompt, 16)
     np.testing.assert_array_equal(vanilla, np.asarray(spec))
     assert int(passes) < 16
+
+
+# ---------------------------------------------------------------------------
+# int4 decode weights (ops.int4)
+# ---------------------------------------------------------------------------
+
+
+def test_int4_quantize_roundtrip():
+    """Group-wise int4: dequantize(quantize(w)) within the 4-bit grid
+    (relative error bounded by half a quantization step per group)."""
+    from dora_tpu.ops.int4 import dequantize_int4, quantize_int4
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((256, 384)), jnp.float32)
+    q = quantize_int4(w)
+    assert q["int4"].shape == (128, 384) and q["int4"].dtype == jnp.uint8
+    deq = dequantize_int4(q)
+    # max error <= scale/2 per group; scale = max|group|/7
+    step = np.asarray(q["gscale"]).max()
+    assert float(jnp.abs(deq - w).max()) <= step / 2 + 1e-6
+
+
+def test_int4_fused_generate_matches_dequantized(monkeypatch):
+    """The fused kernel tier on int4 weights emits the same tokens as
+    the unfused path running on the explicitly dequantized weights —
+    quantization error itself is the only delta, the kernels add none."""
+    from dora_tpu.models import vlm
+
+    monkeypatch.setenv("DORA_INT4_DECODE", "1")
+    cfg = vlm.VLMConfig.tiny()
+    params = vlm.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = vlm.quantize_decode(params)
+    assert vlm.fused_decode_ready(qparams)
+    image = jax.random.uniform(
+        jax.random.PRNGKey(1), (1, cfg.image_size, cfg.image_size, 3)
+    )
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0, cfg.vocab)
+    fused = np.asarray(vlm.generate(qparams, cfg, image, prompt, 8))
+    monkeypatch.setenv("DORA_FUSED_DECODE", "0")
+    ref = np.asarray(vlm.generate(qparams, cfg, image, prompt, 8))
+    np.testing.assert_array_equal(fused, ref)
+    monkeypatch.delenv("DORA_FUSED_DECODE")
+    spec, passes = vlm.generate_speculative(qparams, cfg, image, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(spec), fused)
