@@ -366,9 +366,50 @@ def _lm(params, cfg: Qwen2VLConfig, h, cos, sin, mask, caches=None,
 
 
 def _head(params, cfg: Qwen2VLConfig, dtype):
-    if cfg.tie_embeddings or "lm_head" not in params:
+    head = params.get("lm_head")
+    if isinstance(head, dict):  # quantized (quantize_decode)
+        return head
+    if cfg.tie_embeddings or head is None:
         return params["embed"].astype(dtype).T
-    return params["lm_head"].astype(dtype)
+    return head.astype(dtype)
+
+
+def _head_logits(h, head):
+    """h @ head for a float head array or a quantized head dict."""
+    if isinstance(head, dict):
+        return L.matmul(h, head).astype(jnp.float32)
+    return (h @ head).astype(jnp.float32)
+
+
+def quantize_decode(params, cfg: Qwen2VLConfig) -> dict:
+    """Quantize the LM decode path of a pretrained checkpoint (blocks +
+    head) into the fused kernel layout — the same serving gates as the
+    self-contained VLM (DORA_INT8_DECODE / DORA_INT4_DECODE /
+    DORA_INT8_PURE; see models/vlm.quantize_decode). A tied head is
+    materialized from the embedding transpose so the streamed argmax
+    kernel has a real [D, V] weight; the embedding itself stays float
+    for the gather."""
+    import os
+
+    from dora_tpu.ops.int8_matmul import quantize_int8, quantize_tree
+
+    quantizer = quantize_int8
+    if os.environ.get("DORA_INT4_DECODE"):
+        from dora_tpu.ops.int4 import quantize_int4 as quantizer  # noqa: F811
+
+    keep_bf16 = not os.environ.get("DORA_INT8_PURE")
+    out = dict(params)
+    out["blocks"] = quantize_tree(
+        params["blocks"], keep_bf16=keep_bf16, quantizer=quantizer
+    )
+    head = params.get("lm_head")
+    if cfg.tie_embeddings or head is None:
+        head = jnp.asarray(params["embed"]).T
+    out["lm_head"] = quantize_tree(
+        {"lm_head": jnp.asarray(head)}, keep_bf16=keep_bf16,
+        quantizer=quantizer,
+    )["lm_head"]
+    return out
 
 
 def _embed_with_images(params, cfg: Qwen2VLConfig, input_ids, image_feats, dtype):
@@ -400,7 +441,7 @@ def forward(params, cfg: Qwen2VLConfig, input_ids, image_feats, position_ids):
     t = input_ids.shape[1]
     mask = L.causal_mask(t, t)
     h, _ = _lm(params, cfg, h, cos, sin, mask)
-    return (h @ _head(params, cfg, dtype)).astype(jnp.float32)
+    return _head_logits(h, _head(params, cfg, dtype))
 
 
 def init_cache(cfg: Qwen2VLConfig, batch: int, dtype=None):
@@ -428,23 +469,45 @@ def _generate_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
     )
     caches = init_cache(cfg, b)
     h, caches = _lm(params, cfg, h, cos, sin, mask, caches=caches, cache_index=0)
-    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+    first = jnp.argmax(_head_logits(h[:, -1], head), axis=-1).astype(
         jnp.int32
     )
 
+    from dora_tpu.models import vlm as _vlm
+
+    use_fused = _vlm.fused_decode_ready(params, b)
+
     def step(carry, i):
         token, caches = carry
+        cache_index = t + i
+        if use_fused:
+            # At decode all three M-RoPE axes share the position, so
+            # the per-row tables reduce to standard rope rows at the
+            # ROPE position (delta + i) — distinct from the cache
+            # position (t + i).
+            from dora_tpu.ops import decode_block as DB
+
+            cos_t, sin_t = L.rope_table(
+                cfg.max_seq, cfg.head_dim, base=cfg.rope_theta
+            )
+            cos_rows, sin_rows = DB.rope_rows(cos_t, sin_t, delta[0] + i, 1)
+            x = params["embed"].astype(dtype)[token]  # [1, dim]
+            nxt, caches = _vlm.fused_decode_pass(
+                params, x, caches, cache_index, cos_rows, sin_rows,
+                heads=cfg.heads, kv_heads=cfg.kv_heads,
+                head_dim=cfg.head_dim, layers=cfg.layers, eps=cfg.norm_eps,
+            )
+            return (nxt, caches), token
         # Text continuation: all three rope axes share the same position.
         rope_pos = (delta + i)[:, None]  # [B, 1]
         pos3 = jnp.broadcast_to(rope_pos[None], (3, b, 1))
         cos, sin = _mrope_tables(cfg, pos3)
-        cache_index = t + i
         h = params["embed"].astype(dtype)[token][:, None, :]
         mask = (jnp.arange(cfg.max_seq) <= cache_index)[None, None, None, :]
         h, caches = _lm(
             params, cfg, h, cos, sin, mask, caches=caches, cache_index=cache_index
         )
-        nxt = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        nxt = jnp.argmax(_head_logits(h[:, -1], head), axis=-1).astype(
             jnp.int32
         )
         return (nxt, caches), token
@@ -505,9 +568,13 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
     caches = init_cache(cfg, b)
     h, caches = _lm(params, cfg, h, cos, sin, mask, caches=caches,
                     cache_index=0)
-    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+    first = jnp.argmax(_head_logits(h[:, -1], head), axis=-1).astype(
         jnp.int32
     )
+
+    from dora_tpu.models import vlm as _vlm
+
+    use_fused = _vlm.fused_decode_ready(params, b)
 
     history = jnp.zeros((cfg.max_seq,), jnp.int32)
     history = jax.lax.dynamic_update_slice(
@@ -523,6 +590,21 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
         w = chunk.shape[1]  # k+1, or 1 for an adaptive plain pass
         gen_idx = n_emitted - 1
         cache_index = t + gen_idx
+        if use_fused:
+            from dora_tpu.ops import decode_block as DB
+
+            cos_t, sin_t = L.rope_table(
+                cfg.max_seq, cfg.head_dim, base=cfg.rope_theta
+            )
+            cos_rows, sin_rows = DB.rope_rows(
+                cos_t, sin_t, delta[0] + gen_idx, w
+            )
+            x = params["embed"].astype(dtype)[chunk[0]]  # [W, dim]
+            return _vlm.fused_decode_pass(
+                params, x, caches, cache_index, cos_rows, sin_rows,
+                heads=cfg.heads, kv_heads=cfg.kv_heads,
+                head_dim=cfg.head_dim, layers=cfg.layers, eps=cfg.norm_eps,
+            )
         rope_pos = delta[0] + gen_idx + jnp.arange(w)
         pos3 = jnp.broadcast_to(rope_pos[None, None], (3, 1, w))
         ccos, csin = _mrope_tables(cfg, pos3)
@@ -536,9 +618,9 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
             params, cfg, h, ccos, csin, mask, caches=caches,
             cache_index=cache_index,
         )
-        greedy = jnp.argmax(
-            (h[0] @ head).astype(jnp.float32), axis=-1
-        ).astype(jnp.int32)
+        greedy = jnp.argmax(_head_logits(h[0], head), axis=-1).astype(
+            jnp.int32
+        )
         return greedy, new_caches
 
     return spec_decode.run_loop(

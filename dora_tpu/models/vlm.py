@@ -329,10 +329,29 @@ def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
     x = params["embed"].astype(dtype)[tokens[0]]  # [M, dim]
     cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim)
     cos_rows, sin_rows = DB.rope_rows(cos_t, sin_t, position, m)
-    n_qkv = (cfg.heads + 2 * cfg.kv_heads) * cfg.head_dim
+    return fused_decode_pass(
+        params, x, caches, position, cos_rows, sin_rows,
+        heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        layers=cfg.layers,
+    )
+
+
+def fused_decode_pass(params, x, caches, position, cos_rows, sin_rows, *,
+                      heads: int, kv_heads: int, head_dim: int, layers: int,
+                      eps: float = 1e-6):
+    """The family-agnostic fused decode pass: the caller embeds the
+    tokens and supplies per-row rope tables (standard RoPE here, M-RoPE
+    text continuation in models/hf/qwen2_vl — at decode all three axes
+    share the position, so its rows reduce to standard rows at the rope
+    position, which may differ from the cache ``position``). params
+    needs blocks/out_norm/lm_head in the quantized fused layout."""
+    from dora_tpu.ops import decode_block as DB
+
+    m = x.shape[0]
+    n_qkv = (heads + 2 * kv_heads) * head_dim
     attn = DB.attention_step if m == 1 else DB.attention_chunk_step
     new_caches = {}
-    for i in range(cfg.layers):
+    for i in range(layers):
         blk = params["blocks"][str(i)]
         kc = caches[str(i)]["k"][0]  # [KV, S, hd]
         vc = caches[str(i)]["v"][0]
@@ -344,7 +363,7 @@ def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
         x, kc, vc = attn(
             x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
             kc, vc, wo, swo, position,
-            heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
         )
         new_caches[str(i)] = {"k": kc[None], "v": vc[None]}
         wgu, sgu = _qw(blk["w_gateup"])
@@ -353,9 +372,9 @@ def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
         bgu = blk.get("b_gateup")
         if bgu is None:
             bgu = jnp.zeros((2 * ffn,), jnp.float32)
-        x = DB.mlp_step(x, blk["ffn_norm"], wgu, sgu, bgu, wd, sd)
+        x = DB.mlp_step(x, blk["ffn_norm"], wgu, sgu, bgu, wd, sd, eps=eps)
     wh, sh = _qw(params["lm_head"])
-    greedy = DB.lm_head_argmax(x, params["out_norm"], wh, sh)
+    greedy = DB.lm_head_argmax(x, params["out_norm"], wh, sh, eps=eps)
     return greedy, new_caches
 
 

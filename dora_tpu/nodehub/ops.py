@@ -230,6 +230,13 @@ def make_vlm() -> JaxOperator:
         cfg, params = qwen2_vl.load(
             hf_path, max_seq=int(os.environ.get("DORA_MAX_SEQ", "1024"))
         )
+        if os.environ.get("DORA_INT8_DECODE") or os.environ.get(
+            "DORA_INT4_DECODE"
+        ):
+            # Pretrained decode through the fused kernel tier (round 4):
+            # quantized LM blocks + head; decode scan and speculative
+            # verify route through ops.decode_block automatically.
+            params = qwen2_vl.quantize_decode(params, cfg)
         tok = _hf_tokenizer(hf_path)
         prompt_text = os.environ.get("DORA_PROMPT", "Describe this image.")
         target_h, target_w = qwen2_vl.smart_resize(

@@ -1417,3 +1417,36 @@ def test_vits_bucketed_synthesis_bounded_compiles(vits_checkpoint):
     assert grew["dec"] <= len(frame_buckets), grew
     # and strictly fewer compiles than distinct lengths (the point)
     assert grew["enc"] < len(lengths), grew
+
+
+@pytest.mark.parametrize("width", ["DORA_INT8_DECODE", "DORA_INT4_DECODE"])
+def test_qwen2vl_fused_quantized_decode(qwen2vl_checkpoint, monkeypatch,
+                                        width):
+    """Pretrained decode through the fused kernel tier (round 4): the
+    quantized fused path emits the same tokens as the unfused path on
+    the same quantized weights, for both weight widths — and
+    speculation (fused M-row verify) agrees too."""
+    from dora_tpu.models import vlm as vlm_mod
+    from dora_tpu.models.hf import qwen2_vl
+
+    path, _ = qwen2vl_checkpoint
+    monkeypatch.setenv(width, "1")
+    cfg, params = qwen2_vl.load(path, max_seq=128)
+    qparams = qwen2_vl.quantize_decode(params, cfg)
+    assert vlm_mod.fused_decode_ready(qparams)
+    rng = np.random.default_rng(45)
+    input_ids, pixel_values, grid_thw = _vlm_inputs(cfg, rng)
+
+    fused = np.asarray(
+        qwen2_vl.generate(qparams, cfg, input_ids, pixel_values, grid_thw, 10)
+    )
+    monkeypatch.setenv("DORA_FUSED_DECODE", "0")
+    ref = np.asarray(
+        qwen2_vl.generate(qparams, cfg, input_ids, pixel_values, grid_thw, 10)
+    )
+    np.testing.assert_array_equal(fused, ref)
+    monkeypatch.delenv("DORA_FUSED_DECODE")
+    spec, passes = qwen2_vl.generate_speculative(
+        qparams, cfg, input_ids, pixel_values, grid_thw, 10
+    )
+    np.testing.assert_array_equal(np.asarray(spec), fused)
