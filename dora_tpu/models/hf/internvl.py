@@ -299,7 +299,7 @@ def forward(params, cfg: InternVLConfig, input_ids, image_feats):
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
     mask = L.causal_mask(t, t)
     h, _ = qwen2._lm(params, cfg.text, h, positions, mask)
-    return (h @ qwen2._head(params, cfg.text, dtype)).astype(jnp.float32)
+    return qwen2._head_logits(h, qwen2._head(params, cfg.text, dtype))
 
 
 @partial(jax.jit, static_argnums=(1, 4))
@@ -319,12 +319,21 @@ def _generate_jit(params, cfg: InternVLConfig, input_ids, image_feats,
     h, caches = qwen2._lm(
         params, tc, h, positions, mask, caches=caches, cache_index=0
     )
-    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+    first = jnp.argmax(qwen2._head_logits(h[:, -1], head), axis=-1).astype(
         jnp.int32
     )
 
+    from dora_tpu.models import vlm as _vlm
+
+    use_fused = _vlm.fused_decode_ready(params, b)
+
     def step(carry, _):
         token, caches, position = carry
+        if use_fused:
+            nxt, caches = qwen2.fused_step(
+                params, tc, token[:, None], caches, position
+            )
+            return (nxt, caches, position + 1), token
         h = params["embed"].astype(dtype)[token][:, None, :]
         positions = jnp.broadcast_to(position, (b, 1))
         mask = (jnp.arange(tc.max_seq) <= position)[None, None, None, :]
@@ -333,7 +342,7 @@ def _generate_jit(params, cfg: InternVLConfig, input_ids, image_feats,
             cache_index=position,
         )
         nxt = jnp.argmax(
-            (h[:, -1] @ head).astype(jnp.float32), axis=-1
+            qwen2._head_logits(h[:, -1], head), axis=-1
         ).astype(jnp.int32)
         return (nxt, caches, position + 1), token
 
@@ -408,9 +417,13 @@ def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
     h, caches = qwen2._lm(
         params, tc, h, positions, mask, caches=caches, cache_index=0
     )
-    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+    first = jnp.argmax(qwen2._head_logits(h[:, -1], head), axis=-1).astype(
         jnp.int32
     )
+
+    from dora_tpu.models import vlm as _vlm
+
+    use_fused = _vlm.fused_decode_ready(params, b)
 
     history = jnp.zeros((tc.max_seq,), jnp.int32)
     history = jax.lax.dynamic_update_slice(history, input_ids[0], (0,))
@@ -421,6 +434,8 @@ def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
         # both cache and rotary; chunk[0, 0] is generated index
         # n_emitted-1.
         cache_index = t + n_emitted - 1
+        if use_fused:
+            return qwen2.fused_step(params, tc, chunk, caches, cache_index)
         chunk_pos = cache_index + jnp.arange(chunk.shape[1])
         mask = (
             jnp.arange(tc.max_seq)[None, None, None, :]
@@ -432,7 +447,7 @@ def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
             cache_index=cache_index,
         )
         greedy = jnp.argmax(
-            (h[0] @ head).astype(jnp.float32), axis=-1
+            qwen2._head_logits(h[0], head), axis=-1
         ).astype(jnp.int32)
         return greedy, new_caches
 
@@ -441,6 +456,12 @@ def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
         max_new_tokens=max_new_tokens, seq=tc.max_seq, verify=verify,
         k=k, ngram=ngram,
     )
+
+
+def quantize_decode(params, cfg: "InternVLConfig") -> dict:
+    """Quantize the LM decode path into the fused kernel layout (shared
+    machinery: models/hf/qwen2.quantize_decode; same serving gates)."""
+    return qwen2.quantize_decode(params, cfg.text)
 
 
 # ---------------------------------------------------------------------------

@@ -102,9 +102,70 @@ def map_params(tensors: dict, cfg: Qwen2Config, prefix: str = "model.") -> dict:
 
 
 def _head(params, cfg: Qwen2Config, dtype):
-    if cfg.tie_embeddings or "lm_head" not in params:
+    head = params.get("lm_head")
+    if isinstance(head, dict):  # quantized (quantize_decode)
+        return head
+    if cfg.tie_embeddings or head is None:
         return params["embed"].astype(dtype).T
-    return params["lm_head"].astype(dtype)
+    return head.astype(dtype)
+
+
+def _head_logits(h, head):
+    """h @ head for a float head array or a quantized head dict."""
+    if isinstance(head, dict):
+        return L.matmul(h, head).astype(jnp.float32)
+    return (h @ head).astype(jnp.float32)
+
+
+def quantize_decode(params, cfg) -> dict:
+    """Quantize a Qwen2-class LM's decode path (blocks + head) into the
+    fused kernel layout — shared by the text model, Qwen2-VL, and
+    InternVL (whose text model IS this module). Serving gates:
+    DORA_INT8_DECODE / DORA_INT4_DECODE / DORA_INT8_PURE; a tied head
+    materializes from the embedding transpose (the embedding itself
+    stays float for the gather)."""
+    import os
+
+    from dora_tpu.ops.int8_matmul import quantize_int8, quantize_tree
+
+    quantizer = quantize_int8
+    if os.environ.get("DORA_INT4_DECODE"):
+        from dora_tpu.ops.int4 import quantize_int4 as quantizer  # noqa: F811
+
+    keep_bf16 = not os.environ.get("DORA_INT8_PURE")
+    out = dict(params)
+    out["blocks"] = quantize_tree(
+        params["blocks"], keep_bf16=keep_bf16, quantizer=quantizer
+    )
+    head = params.get("lm_head")
+    if cfg.tie_embeddings or head is None:
+        head = jnp.asarray(params["embed"]).T
+    out["lm_head"] = quantize_tree(
+        {"lm_head": jnp.asarray(head)}, keep_bf16=keep_bf16,
+        quantizer=quantizer,
+    )["lm_head"]
+    return out
+
+
+def fused_step(params, cfg, tokens, caches, position):
+    """Standard-RoPE fused decode pass (ops.decode_block via
+    models/vlm.fused_decode_pass): tokens [1, W] at cache AND rope
+    positions ``position..position+W-1``. Gate with
+    models/vlm.fused_decode_ready."""
+    from dora_tpu.models import vlm as _vlm
+    from dora_tpu.ops import decode_block as DB
+
+    dtype = L.compute_dtype()
+    w = tokens.shape[1]
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim,
+                                base=cfg.rope_theta)
+    cos_rows, sin_rows = DB.rope_rows(cos_t, sin_t, position, w)
+    x = params["embed"].astype(dtype)[tokens[0]]  # [W, dim]
+    return _vlm.fused_decode_pass(
+        params, x, caches, position, cos_rows, sin_rows,
+        heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        layers=cfg.layers, eps=cfg.norm_eps,
+    )
 
 
 def _lm(params, cfg: Qwen2Config, h, positions, mask, caches=None, cache_index=None):
@@ -131,7 +192,7 @@ def forward(params, cfg: Qwen2Config, tokens):
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
     mask = L.causal_mask(t, t)
     h, _ = _lm(params, cfg, h, positions, mask)
-    return (h @ _head(params, cfg, dtype)).astype(jnp.float32)
+    return _head_logits(h, _head(params, cfg, dtype))
 
 
 def init_cache(cfg: Qwen2Config, batch: int, dtype=None):
@@ -166,19 +227,28 @@ def generate(params, cfg: Qwen2Config, prompt_ids, max_new_tokens: int):
     )
     caches = init_cache(cfg, b)
     h, caches = _lm(params, cfg, h, positions, mask, caches=caches, cache_index=0)
-    first = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+    first = jnp.argmax(_head_logits(h[:, -1], head), axis=-1).astype(
         jnp.int32
     )
 
+    from dora_tpu.models import vlm as _vlm
+
+    use_fused = _vlm.fused_decode_ready(params, b)
+
     def step(carry, _):
         token, caches, position = carry
+        if use_fused:
+            nxt, caches = fused_step(
+                params, cfg, token[:, None], caches, position
+            )
+            return (nxt, caches, position + 1), token
         h = params["embed"].astype(dtype)[token][:, None, :]
         positions = jnp.broadcast_to(position, (b, 1))
         mask = (jnp.arange(cfg.max_seq) <= position)[None, None, None, :]
         h, caches = _lm(
             params, cfg, h, positions, mask, caches=caches, cache_index=position
         )
-        nxt = jnp.argmax((h[:, -1] @ head).astype(jnp.float32), axis=-1).astype(
+        nxt = jnp.argmax(_head_logits(h[:, -1], head), axis=-1).astype(
             jnp.int32
         )
         return (nxt, caches, position + 1), token

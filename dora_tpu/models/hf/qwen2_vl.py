@@ -382,34 +382,11 @@ def _head_logits(h, head):
 
 
 def quantize_decode(params, cfg: Qwen2VLConfig) -> dict:
-    """Quantize the LM decode path of a pretrained checkpoint (blocks +
-    head) into the fused kernel layout — the same serving gates as the
-    self-contained VLM (DORA_INT8_DECODE / DORA_INT4_DECODE /
-    DORA_INT8_PURE; see models/vlm.quantize_decode). A tied head is
-    materialized from the embedding transpose so the streamed argmax
-    kernel has a real [D, V] weight; the embedding itself stays float
-    for the gather."""
-    import os
+    """Quantize the LM decode path into the fused kernel layout (shared
+    machinery: models/hf/qwen2.quantize_decode; same serving gates)."""
+    from dora_tpu.models.hf import qwen2
 
-    from dora_tpu.ops.int8_matmul import quantize_int8, quantize_tree
-
-    quantizer = quantize_int8
-    if os.environ.get("DORA_INT4_DECODE"):
-        from dora_tpu.ops.int4 import quantize_int4 as quantizer  # noqa: F811
-
-    keep_bf16 = not os.environ.get("DORA_INT8_PURE")
-    out = dict(params)
-    out["blocks"] = quantize_tree(
-        params["blocks"], keep_bf16=keep_bf16, quantizer=quantizer
-    )
-    head = params.get("lm_head")
-    if cfg.tie_embeddings or head is None:
-        head = jnp.asarray(params["embed"]).T
-    out["lm_head"] = quantize_tree(
-        {"lm_head": jnp.asarray(head)}, keep_bf16=keep_bf16,
-        quantizer=quantizer,
-    )["lm_head"]
-    return out
+    return qwen2.quantize_decode(params, cfg)
 
 
 def _embed_with_images(params, cfg: Qwen2VLConfig, input_ids, image_feats, dtype):

@@ -189,6 +189,10 @@ def make_vlm() -> JaxOperator:
             internvl_path, max_seq=int(os.environ.get("DORA_MAX_SEQ", "1024"))
         )
         params = _maybe_cast(params)
+        if os.environ.get("DORA_INT8_DECODE") or os.environ.get(
+            "DORA_INT4_DECODE"
+        ):
+            params = internvl.quantize_decode(params, cfg)
         tile = cfg.vision.image_size
         cols, rows, n_tiles = internvl.tile_grid(
             width, height, tile=tile, max_num=max_tiles

@@ -1450,3 +1450,32 @@ def test_qwen2vl_fused_quantized_decode(qwen2vl_checkpoint, monkeypatch,
         qparams, cfg, input_ids, pixel_values, grid_thw, 10
     )
     np.testing.assert_array_equal(np.asarray(spec), fused)
+
+
+def test_internvl_fused_quantized_decode(internvl_checkpoint, monkeypatch):
+    """InternVL decode through the fused kernel tier: quantized fused vs
+    unfused-on-the-same-weights token equality, speculation included."""
+    from dora_tpu.models import vlm as vlm_mod
+    from dora_tpu.models.hf import internvl
+
+    path, _ = internvl_checkpoint
+    monkeypatch.setenv("DORA_INT8_DECODE", "1")
+    cfg, params = internvl.load(path, max_seq=128)
+    qparams = internvl.quantize_decode(params, cfg)
+    assert vlm_mod.fused_decode_ready(qparams)
+    rng = np.random.default_rng(46)
+    input_ids, pixel_values = _internvl_inputs(cfg, rng)
+
+    fused = np.asarray(
+        internvl.generate(qparams, cfg, input_ids, pixel_values, 10)
+    )
+    monkeypatch.setenv("DORA_FUSED_DECODE", "0")
+    ref = np.asarray(
+        internvl.generate(qparams, cfg, input_ids, pixel_values, 10)
+    )
+    np.testing.assert_array_equal(fused, ref)
+    monkeypatch.delenv("DORA_FUSED_DECODE")
+    spec, passes = internvl.generate_speculative(
+        qparams, cfg, input_ids, pixel_values, 10
+    )
+    np.testing.assert_array_equal(np.asarray(spec), fused)
