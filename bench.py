@@ -231,6 +231,7 @@ def serving_fps() -> dict:
         "note": "camera->vlm-2b, 4 tok/frame, int8+pipeline-depth-8",
         "outputs": measured,
         "p50_gap_ms": round(data.get("p50_gap_ms", 0.0), 1),
+        "peak_window_fps": data.get("peak_window_fps"),
     }
 
 
@@ -270,6 +271,10 @@ def main() -> int:
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)
         ),
+        # Best sustained 50-output window: capability through tunnel
+        # fetch-latency stalls (KNOWN_ISSUES "session drift").
+        "e2e_peak_window_fps": e2e.get("peak_window_fps"),
+        "e2e_p50_gap_ms": e2e.get("p50_gap_ms"),
         "e2e_note": e2e["note"],
     }
     print(json.dumps(record))

@@ -285,11 +285,22 @@ def bench_e2e(tmp: Path, max_new: int = 4, frames: int = 100,
         window = stamps[warmup:]
         fps = (len(window) - 1) / (window[-1] - window[0])
         gaps = [b - a for a, b in zip(window, window[1:])]
+        # Peak sustained rate: best sliding 50-output window. On the
+        # tunneled chip the device->host fetch latency can degrade
+        # mid-stream (KNOWN_ISSUES), dragging the whole-run mean below
+        # what the pipeline sustains when the tunnel is healthy; the
+        # peak window shows the capability alongside the honest mean.
+        peak = fps
+        w = 50
+        for i in range(max(0, len(window) - w)):
+            cand = (w - 1) / (window[i + w - 1] - window[i])
+            peak = max(peak, cand)
         open("fps.json", "w").write(json.dumps({
             "fps": fps,
             "outputs": len(stamps),
             "measured_outputs": len(window),
             "p50_gap_ms": statistics.median(gaps) * 1e3,
+            "peak_window_fps": peak,
             "fps_incl_warmup": (len(stamps) - 1) / (stamps[-1] - stamps[0]),
         }))
     """))
@@ -353,6 +364,7 @@ def bench_e2e(tmp: Path, max_new: int = 4, frames: int = 100,
         data["fps"], "fps", outputs=data["outputs"],
         measured_outputs=data.get("measured_outputs"),
         p50_gap_ms=round(data.get("p50_gap_ms", 0), 1),
+        peak_window_fps=round(data.get("peak_window_fps", 0), 1),
         vs_baseline=data["fps"] / 25.0,  # north star: 25 FPS
     )
     return data
