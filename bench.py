@@ -197,6 +197,10 @@ def serving_fps() -> dict:
     env = dict(os.environ)
     env.setdefault("DORA_INT8_DECODE", "1")
     env.setdefault("DORA_PIPELINE_DEPTH", "8")
+    # Round 5: device-side output ring — 8 frames share one
+    # device→host fetch, decoupling steady FPS from tunnel RTT
+    # (tpu/fuse.fetch_every_from_env).
+    env.setdefault("DORA_FETCH_EVERY", "8")
     env.setdefault("BENCH_MAX_NEW", "4")
     env.setdefault("BENCH_FRAMES", "6000")
     proc = subprocess.run(

@@ -161,6 +161,13 @@ class CommunicationConfig:
         local = value.get("local", value.get("_unstable_local", "tcp"))
         if isinstance(local, Mapping):
             local = local.get("kind", "tcp")
+        # Reference spellings (dataflow_socket.yml uses "UnixDomain").
+        local = {
+            "UnixDomain": "uds",
+            "Tcp": "tcp",
+            "Shmem": "shmem",
+            "SharedMemory": "shmem",
+        }.get(str(local), str(local).lower())
         remote = value.get("remote", value.get("_unstable_remote", "tcp"))
         if isinstance(remote, Mapping):
             remote = remote.get("kind", "tcp")

@@ -1116,7 +1116,11 @@ async def run_dataflow_async(
     local_comm: str = "tcp",
     timeout_s: float | None = None,
 ) -> DataflowResult:
-    """Run one dataflow to completion with an in-process daemon."""
+    """Run one dataflow to completion with an in-process daemon. A
+    ``communication: {local: uds|shmem|tcp}`` block in the YAML (or the
+    reference's ``_unstable_local`` spelling) overrides the default
+    ``local_comm`` — the dataflow_socket.yml idiom
+    (reference examples/rust-dataflow/dataflow_socket.yml)."""
     if isinstance(dataflow, Descriptor):
         descriptor = dataflow
         working_dir = Path(working_dir or Path.cwd())
@@ -1125,6 +1129,8 @@ async def run_dataflow_async(
         descriptor = Descriptor.read(path)
         working_dir = Path(working_dir or path.parent)
     descriptor.check(working_dir)
+    if local_comm == "tcp":  # explicit non-default flag wins over YAML
+        local_comm = descriptor.communication.local.kind
 
     from dora_tpu.telemetry import install_task_dump, remove_task_dump
 

@@ -336,6 +336,40 @@ def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
     )
 
 
+def _fused_pass(params, x, attn_apply, *, heads: int, kv_heads: int,
+                head_dim: int, layers: int, eps: float):
+    """Shared skeleton of every fused decode pass: per-layer quantized
+    weight unpacking, bias zero-fill, the MLP sweep and the streamed
+    lm_head argmax. ``attn_apply(layer_index, x, blk, wqkv, sqkv, bqkv,
+    wo, swo) -> (x, cache_entry)`` supplies the attention variant
+    (single-row / M-row chunk / B-row batch — they differ only in cache
+    indexing and position plumbing)."""
+    from dora_tpu.ops import decode_block as DB
+
+    n_qkv = (heads + 2 * kv_heads) * head_dim
+    new_caches = {}
+    for i in range(layers):
+        blk = params["blocks"][str(i)]
+        bqkv = blk.get("bqkv")
+        if bqkv is None:
+            bqkv = jnp.zeros((n_qkv,), jnp.float32)
+        wqkv, sqkv = _qw(blk["wqkv"])
+        wo, swo = _qw(blk["wo"])
+        x, new_caches[str(i)] = attn_apply(
+            i, x, blk, wqkv, sqkv, bqkv, wo, swo
+        )
+        wgu, sgu = _qw(blk["w_gateup"])
+        wd, sd = _qw(blk["w_down"])
+        ffn = wd.shape[0] * (2 if "int4" in blk["w_down"] else 1)
+        bgu = blk.get("b_gateup")
+        if bgu is None:
+            bgu = jnp.zeros((2 * ffn,), jnp.float32)
+        x = DB.mlp_step(x, blk["ffn_norm"], wgu, sgu, bgu, wd, sd, eps=eps)
+    wh, sh = _qw(params["lm_head"])
+    greedy = DB.lm_head_argmax(x, params["out_norm"], wh, sh, eps=eps)
+    return greedy, new_caches
+
+
 def fused_decode_pass(params, x, caches, position, cos_rows, sin_rows, *,
                       heads: int, kv_heads: int, head_dim: int, layers: int,
                       eps: float = 1e-6):
@@ -348,34 +382,22 @@ def fused_decode_pass(params, x, caches, position, cos_rows, sin_rows, *,
     from dora_tpu.ops import decode_block as DB
 
     m = x.shape[0]
-    n_qkv = (heads + 2 * kv_heads) * head_dim
     attn = DB.attention_step if m == 1 else DB.attention_chunk_step
-    new_caches = {}
-    for i in range(layers):
-        blk = params["blocks"][str(i)]
+
+    def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
         kc = caches[str(i)]["k"][0]  # [KV, S, hd]
         vc = caches[str(i)]["v"][0]
-        bqkv = blk.get("bqkv")
-        if bqkv is None:
-            bqkv = jnp.zeros((n_qkv,), jnp.float32)
-        wqkv, sqkv = _qw(blk["wqkv"])
-        wo, swo = _qw(blk["wo"])
         x, kc, vc = attn(
             x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
             kc, vc, wo, swo, position,
             heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
         )
-        new_caches[str(i)] = {"k": kc[None], "v": vc[None]}
-        wgu, sgu = _qw(blk["w_gateup"])
-        wd, sd = _qw(blk["w_down"])
-        ffn = wd.shape[0] * (2 if "int4" in blk["w_down"] else 1)
-        bgu = blk.get("b_gateup")
-        if bgu is None:
-            bgu = jnp.zeros((2 * ffn,), jnp.float32)
-        x = DB.mlp_step(x, blk["ffn_norm"], wgu, sgu, bgu, wd, sd, eps=eps)
-    wh, sh = _qw(params["lm_head"])
-    greedy = DB.lm_head_argmax(x, params["out_norm"], wh, sh, eps=eps)
-    return greedy, new_caches
+        return x, {"k": kc[None], "v": vc[None]}
+
+    return _fused_pass(
+        params, x, attn_apply, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, layers=layers, eps=eps,
+    )
 
 
 def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
@@ -414,6 +436,92 @@ def generate(params, cfg: VLMConfig, images, prompt_ids, max_new_tokens: int):
         length=max_new_tokens, unroll=min(unroll, max_new_tokens),
     )
     return tokens.T  # [B, max_new]
+
+
+def fused_batch_ready(params) -> bool:
+    """True when the BATCHED fused tier can serve: same quantized fused
+    layout as :func:`fused_decode_ready`, without the batch-1 gate
+    (ops.decode_block.attention_batch_step serves B independent
+    sequences off one weight stream — the continuous-batching engine's
+    step, models/batch_engine.py)."""
+    return fused_decode_ready(params, 1)
+
+
+def decode_batch_fused(params, cfg: VLMConfig, tokens, caches, positions):
+    """One greedy decode step for B INDEPENDENT sequences.
+
+    tokens: [B] int32; positions: [B] int32 (each row's own cache
+    position); caches: the [B, KV, S, hd]-per-layer tree. One LM weight
+    stream serves all B rows — decode cost is ~flat in B until the
+    per-row attention sweeps dominate. Returns (greedy [B], caches).
+    """
+    from dora_tpu.ops import decode_block as DB
+
+    dtype = L.compute_dtype()
+    x = params["embed"].astype(dtype)[tokens]  # [B, dim]
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim)
+    cos_rows, sin_rows = DB.rope_rows_at(cos_t, sin_t, positions)
+    return fused_decode_pass_batch(
+        params, x, caches, positions, cos_rows, sin_rows,
+        heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        layers=cfg.layers,
+    )
+
+
+def fused_decode_pass_batch(params, x, caches, positions, cos_rows,
+                            sin_rows, *, heads: int, kv_heads: int,
+                            head_dim: int, layers: int, eps: float = 1e-6):
+    """Family-agnostic batched fused pass (caller embeds tokens and
+    gathers per-row rope rows; hf families pass their own rope base)."""
+    from dora_tpu.ops import decode_block as DB
+
+    def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        x, kc, vc = DB.attention_batch_step(
+            x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+            caches[str(i)]["k"], caches[str(i)]["v"], wo, swo, positions,
+            heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
+        )
+        return x, {"k": kc, "v": vc}
+
+    return _fused_pass(
+        params, x, attn_apply, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, layers=layers, eps=eps,
+    )
+
+
+def generate_tp(params, tp_params, cfg: VLMConfig, images, prompt_ids,
+                max_new_tokens: int, mesh):
+    """Greedy generation with the decode scan on the FUSED kernel tier
+    sharded over the tp mesh axis (parallel/fused_tp.py): per-rank
+    Pallas kernels + one f32 psum per sublayer + vocab-sharded argmax.
+    ``tp_params`` comes from fused_tp.prepare_decode_params. Prefill
+    rides the unfused path (runs once; decode dominates). Emits the
+    same tokens as :func:`generate` (asserted in tests/test_fused_tp.py
+    and the driver serving dryrun)."""
+    from dora_tpu.ops import decode_block as DB
+    from dora_tpu.parallel import fused_tp as FTP
+
+    dtype = L.compute_dtype()
+    logits, caches, position = prefill(params, cfg, images, prompt_ids)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    caches = FTP.shard_caches(caches, mesh)
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim)
+
+    def step(carry, _):
+        token, caches, pos = carry
+        cos, sin = DB.rope_rows(cos_t, sin_t, pos, 1)
+        nxt, caches = FTP.decode_pass_tp(
+            tp_params, params["embed"].astype(dtype)[token], caches, pos,
+            cos, sin, heads=cfg.heads, kv_heads=cfg.kv_heads,
+            head_dim=cfg.head_dim, layers=cfg.layers, mesh=mesh,
+        )
+        return (nxt, caches, pos + 1), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (first, caches, jnp.asarray(position, jnp.int32)), None,
+        length=max_new_tokens,
+    )
+    return tokens.T
 
 
 # ---------------------------------------------------------------------------

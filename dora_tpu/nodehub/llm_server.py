@@ -1,0 +1,158 @@
+"""Continuous-batching LLM responder for the OpenAI server.
+
+Reference parity: node-hub/dora-openai-server pairs with ONE llm node
+that answers one request at a time (openai-proxy-server/src/main.rs:
+30-50 — requests serialize through the dataflow). This node batches:
+every ``text`` input carrying a ``request_id`` is admitted into a
+models/batch_engine.BatchEngine slot, and each engine step advances ALL
+active requests one token off a single LM weight stream (the batched
+fused kernels, ops/decode_block.attention_batch_step). Token deltas
+stream back on ``response`` tagged ``{request_id, done}`` — the
+openai_server's concurrent mode routes them to the right SSE stream.
+
+Model: a Qwen2-family checkpoint from ``DORA_HF_CHECKPOINT`` (quantized
+into the fused decode layout — int8 by default, DORA_INT4_DECODE=1 for
+int4); without a checkpoint the node refuses loudly (a chat server with
+random weights helps nobody).
+
+Env: DORA_BATCH_SLOTS (default 4) concurrent streams;
+DORA_MAX_NEW_TOKENS (default 32) per-request cap (a request's
+``max_tokens`` lowers it); DORA_MAX_SEQ cache length.
+
+Dataflow usage::
+
+    - id: llm
+      path: module:dora_tpu.nodehub.llm_server
+      inputs: {text: api/text}
+      outputs: [response]
+"""
+
+from __future__ import annotations
+
+import os
+
+import pyarrow as pa
+
+from dora_tpu.node import Node
+
+
+def main() -> None:
+    from dora_tpu.models.hf import qwen2
+
+    path = os.environ.get("DORA_HF_CHECKPOINT")
+    if not path:
+        raise RuntimeError(
+            "llm_server needs DORA_HF_CHECKPOINT (a Qwen2-family "
+            "safetensors directory)"
+        )
+    max_seq = int(os.environ.get("DORA_MAX_SEQ", "2048"))
+    max_new_cap = int(os.environ.get("DORA_MAX_NEW_TOKENS", "32"))
+    slots = int(os.environ.get("DORA_BATCH_SLOTS", "4"))
+
+    cfg, params = qwen2.load(path, max_seq=max_seq)
+    if not os.environ.get("DORA_INT8_DECODE") and not os.environ.get(
+        "DORA_INT4_DECODE"
+    ):
+        os.environ["DORA_INT8_DECODE"] = "1"  # engine needs the fused layout
+    params = qwen2.quantize_decode(params, cfg)
+
+    from dora_tpu.nodehub.ops import _hf_tokenizer
+
+    tok = _hf_tokenizer(path)
+    eos = None
+    if tok is not None:
+        for name in ("<|im_end|>", "<|endoftext|>", "</s>", "<|eot_id|>"):
+            if name in tok.added:
+                eos = tok.added[name]
+                break
+
+    def encode(text: str) -> list[int]:
+        if tok is not None:
+            return tok.encode(text)
+        from dora_tpu.models import tokenizer
+
+        return [t % cfg.vocab for t in tokenizer.encode(text)]
+
+    def decode_one(token: int) -> str:
+        if tok is not None:
+            return tok.decode([token])
+        from dora_tpu.models import tokenizer
+
+        return tokenizer.decode([token])
+
+    engine = qwen2.make_batch_engine(params, cfg, max_slots=slots, eos=eos)
+    node = Node()
+    #: requests that arrived while every slot was busy (FIFO admission;
+    #: only length-admissible requests ever enter, so a freed slot can
+    #: always take the head)
+    backlog: list[tuple[str, list[int], int]] = []
+    #: engine key -> wire request_id (None for untagged requests from
+    #: the serial openai_server mode, whose chunks must carry NO
+    #: request_id so the server's legacy queue receives them)
+    wire_ids: dict[str, str | None] = {}
+    anon_counter = [0]
+
+    def emit_text(key: str, text: str, done: bool) -> None:
+        meta: dict = {"done": bool(done)}
+        rid = wire_ids.get(key)
+        if rid is not None:
+            meta["request_id"] = rid
+        node.send_output("response", pa.array([text]), meta)
+        if done:
+            wire_ids.pop(key, None)
+
+    def emit(key: str, token: int, done: bool) -> None:
+        emit_text(key, decode_one(token), done)
+
+    def start(key: str, ids: list[int], max_new: int) -> None:
+        token, done = engine.submit(key, ids, max_new)
+        emit(key, token, done)
+
+    def admit_backlog() -> None:
+        while backlog and engine.free_slots:
+            start(*backlog.pop(0))
+
+    try:
+        while True:
+            # Active decode: poll only (the engine must keep stepping);
+            # idle: park in recv until a request arrives.
+            event = node.recv(timeout=0.0 if engine.active else 0.25)
+            if event is None and node.stream_ended and engine.active == 0:
+                break
+            if event is not None:
+                if event["type"] == "STOP":
+                    break
+                if event["type"] == "INPUT":
+                    meta = event.get("metadata") or {}
+                    rid = meta.get("request_id")
+                    value = event["value"]
+                    text = (
+                        value.to_pylist()[0]
+                        if isinstance(value, pa.Array)
+                        else bytes(value or b"").decode(errors="replace")
+                    )
+                    anon_counter[0] += 1
+                    key = rid if rid is not None else f"anon-{anon_counter[0]}"
+                    wire_ids[key] = rid
+                    ids = encode(text) or [0]
+                    max_new = min(
+                        int(meta.get("max_new_tokens", max_new_cap)),
+                        max_new_cap,
+                    )
+                    if not engine.fits(len(ids), max_new):
+                        # Oversized: close the stream empty — never
+                        # fabricate a token as a "successful" answer.
+                        emit_text(key, "", True)
+                    elif not engine.free_slots:
+                        backlog.append((key, ids, max_new))
+                    else:
+                        start(key, ids, max_new)
+            for key, token, done in engine.step():
+                emit(key, token, done)
+            admit_backlog()
+    finally:
+        node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,17 @@ following the first chunk. HTTP requests are merged into the node's
 event loop through a thread-safe queue — the stdlib counterpart of the
 reference proxy's merged external-events stream (main.rs:37,72).
 
+Concurrent mode (``DORA_OPENAI_CONCURRENT=1``, round 5): requests are
+NOT serialized. Each POST publishes its prompt tagged with a
+``request_id`` and response chunks route back by that id — pair with a
+continuous-batching responder (nodehub/llm_server.py +
+models/batch_engine.py) and N clients stream interleaved tokens
+concurrently, each decode step serving every active request off one LM
+weight pass. The reference's proxy serializes requests through the
+dataflow (openai-proxy-server/src/main.rs:30-50); this is the axis it
+concedes. Responder contract: every ``response`` message carries
+metadata ``request_id`` (echoed) and ``done`` (bool, last chunk).
+
 Dataflow usage::
 
     - id: api
@@ -39,12 +50,20 @@ from dora_tpu.node import Node
 
 
 def main() -> None:
+    import uuid
+
     port = int(os.environ.get("PORT", "8123"))
     timeout_s = float(os.environ.get("RESPONSE_TIMEOUT", "30"))
     max_requests = int(os.environ.get("MAX_REQUESTS", "0"))  # 0 = serve forever
     quiet_s = float(os.environ.get("STREAM_QUIET_MS", "300")) / 1000.0
+    concurrent = os.environ.get("DORA_OPENAI_CONCURRENT", "0") not in (
+        "", "0"
+    )
     node = Node()
     responses: queue.Queue = queue.Queue()
+    #: concurrent mode: request_id -> its private chunk queue
+    routed: dict[str, queue.Queue] = {}
+    routed_lock = threading.Lock()
     send_lock = threading.Lock()
     served = [0]
 
@@ -79,6 +98,9 @@ def main() -> None:
                 return
             stream = bool(body.get("stream"))
             model = body.get("model", "dora-tpu")
+            if concurrent:
+                self._serve_concurrent(body, text, stream, model)
+                return
             with send_lock:
                 # Drain stale responses, publish, await the next one.
                 while not responses.empty():
@@ -130,6 +152,78 @@ def main() -> None:
                         )
                 finally:
                     served[0] += 1
+
+        def _serve_concurrent(self, body, text, stream, model):
+            """Routed request: publish tagged with a request_id, stream
+            chunks back as they arrive — other requests interleave
+            freely (the responder batches them; nothing serializes)."""
+            rid = uuid.uuid4().hex[:12]
+            chunks: queue.Queue = queue.Queue()
+            with routed_lock:
+                routed[rid] = chunks
+            try:
+                meta = {"request_id": rid}
+                if isinstance(body.get("max_tokens"), int):
+                    meta["max_new_tokens"] = body["max_tokens"]
+                with send_lock:  # send_output is not thread-safe
+                    node.send_output("text", pa.array([text]), meta)
+                if stream:
+                    self._sse_start()
+                    self._sse_chunk(model, {"role": "assistant"})
+                parts: list[str] = []
+                finished = False
+                while True:
+                    try:
+                        delta, done = chunks.get(timeout=timeout_s)
+                    except queue.Empty:
+                        if not stream:
+                            # Stalled mid-answer: a truncated completion
+                            # marked "stop" would silently lie — fail
+                            # like the serial path does.
+                            self.send_error(
+                                504, "dataflow did not answer in time"
+                            )
+                            return
+                        break
+                    if delta:
+                        if stream:
+                            self._sse_chunk(model, {"content": delta})
+                        else:
+                            parts.append(delta)
+                    if done:
+                        finished = True
+                        break
+                if stream:
+                    # A stream that timed out before the responder's
+                    # done marker is truncated: say so ("length"), don't
+                    # claim a clean stop.
+                    self._sse_chunk(
+                        model, {}, finish="stop" if finished else "length"
+                    )
+                    self.wfile.write(b"data: [DONE]\n\n")
+                else:
+                    self._json(
+                        {
+                            "id": f"chatcmpl-{rid}",
+                            "object": "chat.completion",
+                            "created": int(time.time()),
+                            "model": model,
+                            "choices": [
+                                {
+                                    "index": 0,
+                                    "message": {
+                                        "role": "assistant",
+                                        "content": "".join(parts),
+                                    },
+                                    "finish_reason": "stop",
+                                }
+                            ],
+                        }
+                    )
+            finally:
+                with routed_lock:
+                    routed.pop(rid, None)
+                served[0] += 1
 
         def _sse_start(self):
             self.send_response(200)
@@ -186,6 +280,14 @@ def main() -> None:
                     answer = tokenizer.decode(items)
             else:
                 answer = bytes(value or b"").decode(errors="replace")
+            meta = event.get("metadata") or {}
+            rid = meta.get("request_id")
+            if rid is not None:
+                with routed_lock:
+                    target = routed.get(rid)
+                if target is not None:  # client gone: drop silently
+                    target.put((answer, bool(meta.get("done"))))
+                continue
             responses.put(answer)
     finally:
         server.shutdown()

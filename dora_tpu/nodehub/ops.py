@@ -294,6 +294,35 @@ def make_vlm() -> JaxOperator:
         batch_ok=prompt.shape[0] == 1,
     )
 
+    # Round-5 composition: on a DORA_MESH with tp>1 and a quantized
+    # fused layout, the decode scan rides the tensor-parallel KERNEL
+    # tier (parallel/fused_tp.py) instead of the unfused XLA path — the
+    # fastest path and the multi-chip path are the same path. The
+    # prepared tp tree lives in the closure (not operator state): the
+    # executor's sharding rules must not re-place its per-rank layout.
+    tp_setup = None
+    if vlm.fused_decode_ready(params, prompt.shape[0]) and not speculative:
+        from dora_tpu.parallel import fused_tp as FTP
+        from dora_tpu.tpu.fuse import mesh_from_env
+
+        mesh = mesh_from_env()
+        tp = FTP.tp_degree(mesh)
+        if mesh is not None and FTP.tp_compatible(
+            tp, heads=cfg.heads, kv_heads=cfg.kv_heads, ffn=cfg.ffn,
+            vocab=cfg.vocab,
+        ):
+            try:
+                tp_setup = (
+                    FTP.prepare_decode_params(
+                        params, mesh, heads=cfg.heads,
+                        kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+                        layers=cfg.layers,
+                    ),
+                    mesh,
+                )
+            except ValueError:  # int4 groups do not tile on this mesh
+                tp_setup = None
+
     def step(state, inputs):
         image = _normalize(inputs["image"])[None]
         if speculative:
@@ -301,6 +330,11 @@ def make_vlm() -> JaxOperator:
             # k+1 per model pass (vlm.generate_speculative).
             tokens, _ = vlm.generate_speculative(
                 state, cfg, image, prompt, max_new
+            )
+        elif tp_setup is not None:
+            tokens = vlm.generate_tp(
+                state, tp_setup[0], cfg, image, prompt, max_new,
+                tp_setup[1],
             )
         else:
             tokens = vlm.generate(state, cfg, image, prompt, max_new)

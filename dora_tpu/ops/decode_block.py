@@ -79,7 +79,12 @@ def _wdot(x, w_ref, s, *, int4: bool):
     ng = s.shape[0]  # group count; group size = K // ng
     m = x.shape[0]
     gsz = k // ng
-    q3 = unpack_grouped(w_ref[...], ng, dtype)  # [ng, G, BN]
+    # BIASED unpack (values q+8 in 0..15): the bias folds out of the
+    # accumulator instead — ``x @ (q'-8) = x @ q' - 8*sum(x)`` per
+    # group — deleting one VPU subtract per nibble from the unpack,
+    # which KNOWN_ISSUES measured as the int4 bottleneck (the correction
+    # term costs O(ng*M) flops against O(K*N) saved subtracts).
+    q3 = unpack_grouped(w_ref[...], ng, dtype, biased=True)  # [ng, G, BN]
     # Grouped batched dot with f32 scale application on the partials.
     # Measured on v5e this beats folding scales into the weights
     # (307 tok/s) — the fold pays a VPU multiply on every weight value;
@@ -94,7 +99,8 @@ def _wdot(x, w_ref, s, *, int4: bool):
         x3, q3, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )  # [ng, M, BN]
-    scaled = parts * s.astype(jnp.float32)[:, None, :]
+    xsum = jnp.sum(x3.astype(jnp.float32), axis=2)  # [ng, M]
+    scaled = (parts - 8.0 * xsum[:, :, None]) * s.astype(jnp.float32)[:, None, :]
     return jnp.sum(scaled, axis=0)
 
 
@@ -119,6 +125,7 @@ def _attn_kernel(
     out_ref, kc_out, vc_out,
     kv_row, kblk, vblk, sem,
     *, heads: int, kv_heads: int, head_dim: int, bs: int, eps: float,
+    residual: bool,
 ):
     pos = pos_ref[0]
     half = head_dim // 2
@@ -256,18 +263,24 @@ def _attn_kernel(
         attn.reshape(1, heads * head_dim).astype(dtype), wo_ref,
         swo_ref[...], int4=int4,
     )
-    out_ref[...] = (x_ref[...].astype(jnp.float32) + o).astype(out_ref.dtype)
+    if residual:
+        o = x_ref[...].astype(jnp.float32) + o
+    # residual=False: emit the raw f32 sublayer delta — the tensor-
+    # parallel pass (parallel/fused_tp.py) psums per-rank partials in f32
+    # and adds the residual outside, so sharded math stays exact.
+    out_ref[...] = o.astype(out_ref.dtype)
     kwr.wait()
     vwr.wait()
 
 
 @functools.partial(
-    jax.jit, static_argnames=("heads", "kv_heads", "head_dim", "eps")
+    jax.jit,
+    static_argnames=("heads", "kv_heads", "head_dim", "eps", "residual"),
 )
 def attention_step(
     x, norm_w, wqkv, sqkv, bqkv, cos_full, sin_signed, k_cache, v_cache,
     wo, swo, position, *, heads: int, kv_heads: int, head_dim: int,
-    eps: float = 1e-6,
+    eps: float = 1e-6, residual: bool = True,
 ):
     """One fused decode attention sublayer.
 
@@ -275,7 +288,9 @@ def attention_step(
     [D/2, ...] uint8 with group scales; caches [KV, S, hd] (updated in
     place at ``position`` — the returned caches alias the inputs);
     cos_full/sin_signed: [1, hd] position-gathered rope rows (see vlm
-    rope prep). Returns (x_out, k_cache, v_cache).
+    rope prep). Returns (x_out, k_cache, v_cache). With
+    ``residual=False`` the output is the raw f32 sublayer delta
+    (``attn @ wo`` only) for the tensor-parallel partial-sum path.
     """
     seq = k_cache.shape[1]
     bs = min(512, seq)
@@ -284,7 +299,7 @@ def attention_step(
     n_qkv = wqkv.shape[1]
     kernel = functools.partial(
         _attn_kernel, heads=heads, kv_heads=kv_heads, head_dim=head_dim,
-        bs=bs, eps=eps,
+        bs=bs, eps=eps, residual=residual,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -318,7 +333,9 @@ def attention_step(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((1, d), x.dtype),
+            jax.ShapeDtypeStruct(
+                (1, d), x.dtype if residual else jnp.float32
+            ),
             jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
             jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
         ],
@@ -348,7 +365,7 @@ def _attn_chunk_kernel(
     out_ref, kc_out, vc_out,
     kv_win, kblk, vblk, sem,
     *, heads: int, kv_heads: int, head_dim: int, bs: int, eps: float,
-    m: int, win: int, seq: int,
+    m: int, win: int, seq: int, residual: bool,
 ):
     """M-row decode step: rows occupy positions pos..pos+m-1, attend the
     prior cache (idx < pos) plus each other causally (from registers).
@@ -514,18 +531,21 @@ def _attn_chunk_kernel(
         .reshape(m, heads * head_dim)
     )
     o = _wdot(attn.astype(dtype), wo_ref, swo_ref[...], int4=int4)
-    out_ref[...] = (x_ref[...].astype(jnp.float32) + o).astype(out_ref.dtype)
+    if residual:
+        o = x_ref[...].astype(jnp.float32) + o
+    out_ref[...] = o.astype(out_ref.dtype)
     kwr.wait()
     vwr.wait()
 
 
 @functools.partial(
-    jax.jit, static_argnames=("heads", "kv_heads", "head_dim", "eps")
+    jax.jit,
+    static_argnames=("heads", "kv_heads", "head_dim", "eps", "residual"),
 )
 def attention_chunk_step(
     x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_cache, v_cache,
     wo, swo, position, *, heads: int, kv_heads: int, head_dim: int,
-    eps: float = 1e-6,
+    eps: float = 1e-6, residual: bool = True,
 ):
     """M-row fused attention sublayer (speculative verify).
 
@@ -546,6 +566,7 @@ def attention_chunk_step(
     kernel = functools.partial(
         _attn_chunk_kernel, heads=heads, kv_heads=kv_heads,
         head_dim=head_dim, bs=bs, eps=eps, m=m, win=win, seq=seq,
+        residual=residual,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -579,7 +600,9 @@ def attention_chunk_step(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((m, d), x.dtype),
+            jax.ShapeDtypeStruct(
+                (m, d), x.dtype if residual else jnp.float32
+            ),
             jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
             jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
         ],
@@ -596,6 +619,255 @@ def attention_chunk_step(
 
 
 # ---------------------------------------------------------------------------
+# batched attention (continuous batching: B independent sequences)
+# ---------------------------------------------------------------------------
+
+
+def _attn_batch_kernel(
+    pos_ref,  # SMEM (B,) int32 — per-row positions, scalar prefetch
+    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+    kc_in, vc_in, wo_ref, swo_ref,
+    out_ref, kc_out, vc_out,
+    kv_row, kblk, vblk, sem, wsem,
+    *, heads: int, kv_heads: int, head_dim: int, bs: int, eps: float,
+    batch: int, residual: bool,
+):
+    """B-row decode step over B INDEPENDENT sequences: row b sits at its
+    own position in its own cache plane ``[b]``. One weight stream (the
+    HBM-bandwidth cost of a single decode step) serves every row — the
+    continuous-batching workhorse. Rows never attend each other."""
+    half = head_dim // 2
+    dtype = x_ref.dtype
+    int4 = wqkv_ref.dtype == jnp.uint8
+    group = heads // kv_heads
+    scale = 1.0 / (head_dim ** 0.5)
+
+    # --- projections (all rows at once: one weight pass) --------------------
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [B, D]
+    qkv = _wdot(h, wqkv_ref, sqkv_ref[...], int4=int4) + bqkv_ref[...].astype(
+        jnp.float32
+    )  # [B, (H+2KV)*hd]
+    cos_b = cos_ref[...].astype(jnp.float32)  # [B, hd]
+    sin_b = sin_ref[...].astype(jnp.float32)
+
+    qf = qkv[:, : heads * head_dim].reshape(batch * heads, head_dim)
+    kf = qkv[:, heads * head_dim : (heads + kv_heads) * head_dim].reshape(
+        batch * kv_heads, head_dim
+    )
+    vf = qkv[:, (heads + kv_heads) * head_dim :].reshape(
+        batch * kv_heads, head_dim
+    )
+
+    def _expand(t, reps):
+        return jnp.broadcast_to(
+            t[:, None, :], (batch, reps, head_dim)
+        ).reshape(batch * reps, head_dim)
+
+    q = _rotate(qf, _expand(cos_b, heads), _expand(sin_b, heads), half)
+    k = _rotate(kf, _expand(cos_b, kv_heads), _expand(sin_b, kv_heads), half)
+    q_b = q.reshape(batch, heads, head_dim)
+    k_b = k.reshape(batch, kv_heads, head_dim)
+    v_b = vf.reshape(batch, kv_heads, head_dim)
+
+    # --- per-row cache RMW (aligned 8-row windows, write-back overlapped) ---
+    pending = []
+    for b in range(batch):
+        pos = pos_ref[b]
+        aligned = pl.multiple_of(pos // 8 * 8, 8)
+        rd_k = pltpu.make_async_copy(
+            kc_out.at[b, :, pl.ds(aligned, 8), :], kv_row.at[0, b],
+            sem.at[0],
+        )
+        rd_v = pltpu.make_async_copy(
+            vc_out.at[b, :, pl.ds(aligned, 8), :], kv_row.at[1, b],
+            sem.at[1],
+        )
+        rd_k.start()
+        rd_v.start()
+        rd_k.wait()
+        rd_v.wait()
+        row_sel = (
+            jax.lax.broadcasted_iota(jnp.int32, (kv_heads, 8, head_dim), 1)
+            == pos - aligned
+        )
+        kv_row[0, b] = jnp.where(
+            row_sel, k_b[b][:, None, :].astype(kv_row.dtype), kv_row[0, b]
+        )
+        kv_row[1, b] = jnp.where(
+            row_sel, v_b[b][:, None, :].astype(kv_row.dtype), kv_row[1, b]
+        )
+        wr_k = pltpu.make_async_copy(
+            kv_row.at[0, b], kc_out.at[b, :, pl.ds(aligned, 8), :],
+            wsem.at[0, b],
+        )
+        wr_v = pltpu.make_async_copy(
+            kv_row.at[1, b], vc_out.at[b, :, pl.ds(aligned, 8), :],
+            wsem.at[1, b],
+        )
+        wr_k.start()
+        wr_v.start()
+        pending += [wr_k, wr_v]
+
+    # --- per-row flash sweep over the prior context -------------------------
+    attn_rows = []
+    for b in range(batch):
+        pos = pos_ref[b]
+        nblocks = (pos + bs - 1) // bs
+        qb = q_b[b]  # [H, hd]
+
+        def body(blk, carry, pos=pos, qb=qb, b=b):
+            m_run, l_run, acc = carry
+            kcp = pltpu.make_async_copy(
+                kc_out.at[b, :, pl.ds(blk * bs, bs), :], kblk, sem.at[2]
+            )
+            vcp = pltpu.make_async_copy(
+                vc_out.at[b, :, pl.ds(blk * bs, bs), :], vblk, sem.at[3]
+            )
+            kcp.start()
+            vcp.start()
+            kcp.wait()
+            vcp.wait()
+            live = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1) + blk * bs
+            ) < pos
+            scores = []
+            for g in range(kv_heads):
+                s_g = jax.lax.dot_general(
+                    qb[g * group : (g + 1) * group].astype(dtype),
+                    kblk[g].astype(dtype),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                scores.append(s_g)
+            s = jnp.concatenate(scores, axis=0) * scale
+            s = jnp.where(live, s, -jnp.inf)
+            m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_run - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = []
+            for g in range(kv_heads):
+                pv.append(
+                    jax.lax.dot(
+                        p[g * group : (g + 1) * group].astype(dtype),
+                        vblk[g].astype(dtype),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            acc_new = acc * alpha + jnp.concatenate(pv, axis=0)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((heads, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((heads, 1), jnp.float32)
+        a0 = jnp.zeros((heads, head_dim), jnp.float32)
+        m_fin, l_fin, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, a0))
+
+        # fold in the current position from registers (exact merge)
+        q3 = qb.reshape(kv_heads, group, head_dim)
+        s_new = (
+            jnp.sum(q3 * k_b[b][:, None, :], axis=-1).reshape(heads, 1)
+            * scale
+        )
+        m2 = jnp.maximum(m_fin, s_new)
+        alpha = jnp.exp(m_fin - m2)
+        w_new = jnp.exp(s_new - m2)
+        l2 = l_fin * alpha + w_new
+        v_full = jnp.broadcast_to(
+            v_b[b][:, None, :], (kv_heads, group, head_dim)
+        ).reshape(heads, head_dim)
+        attn_rows.append((acc * alpha + w_new * v_full) / l2)  # [H, hd]
+
+    attn = jnp.stack(attn_rows, axis=0).reshape(batch, heads * head_dim)
+
+    # --- output projection + residual ---------------------------------------
+    o = _wdot(attn.astype(dtype), wo_ref, swo_ref[...], int4=int4)
+    if residual:
+        o = x_ref[...].astype(jnp.float32) + o
+    out_ref[...] = o.astype(out_ref.dtype)
+    for copy in pending:
+        copy.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("heads", "kv_heads", "head_dim", "eps", "residual"),
+)
+def attention_batch_step(
+    x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_caches, v_caches,
+    wo, swo, positions, *, heads: int, kv_heads: int, head_dim: int,
+    eps: float = 1e-6, residual: bool = True,
+):
+    """Fused decode attention for B independent sequences.
+
+    x: [B, D]; caches: [B, KV, S, hd] (updated in place — row b at
+    ``positions[b]``); cos_rows/sin_rows: [B, hd] per-row rope rows
+    gathered at each row's position (rope_rows_at). Weight layout
+    matches :func:`attention_step`. Returns (x_out [B, D], k_caches,
+    v_caches). Rows are independent: nothing attends across rows, so an
+    idle slot just burns its own flash sweep (mask at the caller).
+    """
+    batch = x.shape[0]
+    seq = k_caches.shape[2]
+    bs = min(512, seq)
+    assert seq % bs == 0, (seq, bs)
+    d = x.shape[-1]
+    n_qkv = wqkv.shape[1]
+    kernel = functools.partial(
+        _attn_batch_kernel, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, bs=bs, eps=eps, batch=batch, residual=residual,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # norm_w
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
+            pl.BlockSpec(memory_space=pl.ANY),      # k_caches (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),      # v_caches (HBM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, batch, kv_heads, 8, head_dim), k_caches.dtype),
+            pltpu.VMEM((kv_heads, bs, head_dim), k_caches.dtype),
+            pltpu.VMEM((kv_heads, bs, head_dim), v_caches.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SemaphoreType.DMA((2, batch)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (batch, d), x.dtype if residual else jnp.float32
+            ),
+            jax.ShapeDtypeStruct(k_caches.shape, k_caches.dtype),
+            jax.ShapeDtypeStruct(v_caches.shape, v_caches.dtype),
+        ],
+        input_output_aliases={8: 1, 9: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        jnp.asarray(positions, jnp.int32).reshape(batch),
+        x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
+        cos_rows, sin_rows, k_caches, v_caches, wo, swo,
+    )
+
+
+# ---------------------------------------------------------------------------
 # MLP block
 # ---------------------------------------------------------------------------
 
@@ -603,6 +875,7 @@ def attention_chunk_step(
 def _mlp_kernel(
     x_ref, nw_ref, gate_ref, up_ref, sg_ref, su_ref, bg_ref, bu_ref,
     down_ref, sd_ref, out_ref, acc_ref, *, nf: int, eps: float, int4: bool,
+    residual: bool,
 ):
     fi = pl.program_id(0)
     dtype = x_ref.dtype
@@ -643,9 +916,9 @@ def _mlp_kernel(
             # Per-column down scale commutes with the ffn sweep: apply
             # once on the final accumulator.
             acc = acc * sd_ref[...].astype(jnp.float32)
-        out_ref[...] = (
-            x_ref[...].astype(jnp.float32) + acc
-        ).astype(out_ref.dtype)
+        if residual:
+            acc = x_ref[...].astype(jnp.float32) + acc
+        out_ref[...] = acc.astype(out_ref.dtype)
 
 
 def _pick_bf(ffn: int) -> int:
@@ -662,9 +935,9 @@ def _pick_bf(ffn: int) -> int:
     return ffn
 
 
-@functools.partial(jax.jit, static_argnames=("eps",))
+@functools.partial(jax.jit, static_argnames=("eps", "residual"))
 def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
-             *, eps: float = 1e-6):
+             *, eps: float = 1e-6, residual: bool = True):
     """Fused SwiGLU decode sublayer: one grid sweep over ffn tiles.
 
     w_gateup: int8 [D, 2F] (gate | up concatenated — quantize_tree
@@ -679,7 +952,9 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
     f = w_down.shape[0] * (2 if int4 else 1)
     bf = _pick_bf(f)
     nf = f // bf
-    kernel = functools.partial(_mlp_kernel, nf=nf, eps=eps, int4=int4)
+    kernel = functools.partial(
+        _mlp_kernel, nf=nf, eps=eps, int4=int4, residual=residual
+    )
     if int4:
         wrows, drows = d // 2, bf // 2  # packed row counts
         srows = s_gateup.shape[0]       # groups over D (gate/up K dim)
@@ -703,7 +978,9 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
             pl.BlockSpec((sdrows, d), lambda i: (0, 0)),  # down scale
         ],
         out_specs=pl.BlockSpec((mrows, d), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((mrows, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (mrows, d), x.dtype if residual else jnp.float32
+        ),
         scratch_shapes=[pltpu.VMEM((mrows, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
@@ -724,7 +1001,7 @@ def mlp_step(x, norm_w, w_gateup, s_gateup, b_gateup, w_down, s_down,
 
 
 def _head_kernel(
-    x_ref, nw_ref, w_ref, s_ref, out_ref, best_ref, besti_ref,
+    x_ref, nw_ref, w_ref, s_ref, out_ref, val_ref, best_ref, besti_ref,
     *, nv: int, bv: int, vocab: int, eps: float,
 ):
     vi = pl.program_id(0)
@@ -753,17 +1030,21 @@ def _head_kernel(
     @pl.when(vi == nv - 1)
     def _finalize():
         out_ref[...] = besti_ref[...]
+        val_ref[...] = best_ref[...]
 
 
-@functools.partial(jax.jit, static_argnames=("eps",))
-def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
+@functools.partial(jax.jit, static_argnames=("eps", "return_val"))
+def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6,
+                   return_val: bool = False):
     """Greedy next-token ids straight from the kernel.
 
     x: [M, D] (M = 1 vanilla decode, k+1 speculative verify); w: int8
     [D, V] or int4-packed [D/2, V] uint8 with group scales. Streams the
     head by vocab tile with a running per-row
     argmax — no [M, V] f32 logits materialize anywhere. Returns [M]
-    int32.
+    int32; with ``return_val`` additionally the winning logit value
+    [M] f32 (the tensor-parallel pass combines per-rank winners with a
+    pmax/pmin pair — see parallel/fused_tp.py).
     """
     import os
 
@@ -785,7 +1066,7 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
     )
     wrows = d // 2 if int4 else d
     srows = s.shape[0] if int4 else 1
-    out = pl.pallas_call(
+    out, val = pl.pallas_call(
         kernel,
         grid=(nv,),
         in_specs=[
@@ -794,8 +1075,14 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
             pl.BlockSpec((wrows, bv), lambda i: (0, i)),
             pl.BlockSpec((srows, bv), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((m, 1), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((m, 1), jnp.int32),
+        out_specs=[
+            pl.BlockSpec((m, 1), lambda i: (0, 0)),
+            pl.BlockSpec((m, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, 1), jnp.int32),
+            jax.ShapeDtypeStruct((m, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((m, 1), jnp.float32),
             pltpu.VMEM((m, 1), jnp.int32),
@@ -805,12 +1092,26 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6):
         ),
         interpret=_interpret(),
     )(x, norm_w.reshape(1, d), w, s)
+    if return_val:
+        return out[:, 0], val[:, 0]
     return out[:, 0]
 
 
 # ---------------------------------------------------------------------------
 # rope row prep (shared by the fused step)
 # ---------------------------------------------------------------------------
+
+
+def rope_rows_at(cos_table, sin_table, positions):
+    """Per-row rope rows at INDEPENDENT positions [B] (the batched
+    decode shape — each sequence sits at its own position). Returns two
+    [B, hd] f32 arrays in the kernel's full-width layout."""
+    cos = jnp.take(cos_table, positions, axis=0)
+    sin = jnp.take(sin_table, positions, axis=0)
+    return (
+        jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32),
+        jnp.concatenate([-sin, sin], axis=-1).astype(jnp.float32),
+    )
 
 
 def rope_rows(cos_table, sin_table, position, length: int = 1):

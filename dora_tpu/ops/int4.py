@@ -68,19 +68,29 @@ def quantize_int4(w, keep_bf16: bool = False) -> dict:
     return out
 
 
-def unpack_grouped(packed, n_groups: int, dtype):
-    """Packed [K/2, N] u8 -> q [n_groups, G, N] in ``dtype`` (bias
-    removed), ready for the grouped matmul. Works on any slice that is
-    a whole number of groups. The bias subtraction happens in the float
-    compute dtype (exact for |q| <= 8): Mosaic does not legalize i8
-    vector subtraction."""
+def unpack_grouped(packed, n_groups: int, dtype, biased: bool = False):
+    """Packed [K/2, N] u8 -> q [n_groups, G, N] in ``dtype``, ready for
+    the grouped matmul. Works on any slice that is a whole number of
+    groups.
+
+    With ``biased`` the stored q+8 values (0..15) come back as-is — the
+    caller folds the bias out of the ACCUMULATOR instead
+    (``x @ (q'-8) == x @ q' - 8*sum(x)`` per group), which deletes one
+    VPU subtract per nibble from the bandwidth-critical unpack (round-5
+    shaving of the KNOWN_ISSUES int4 VPU bound). Otherwise the bias
+    subtraction happens in the float compute dtype (exact for
+    |q| <= 8): Mosaic does not legalize i8 vector subtraction."""
     k2, n = packed.shape
     half = k2 // n_groups  # G/2 packed rows per group
     blocks = packed.reshape(n_groups, half, n).astype(jnp.int32)
     # Mosaic legalizes neither i8 vector subtraction nor u8->bf16 casts;
-    # widen to i32 for the bias removal, then cast to the compute dtype.
-    lo = ((blocks & 0xF) - 8).astype(dtype)
-    hi = ((blocks >> 4) - 8).astype(dtype)
+    # widen to i32, then cast to the compute dtype.
+    if biased:
+        lo = (blocks & 0xF).astype(dtype)
+        hi = (blocks >> 4).astype(dtype)
+    else:
+        lo = ((blocks & 0xF) - 8).astype(dtype)
+        hi = ((blocks >> 4) - 8).astype(dtype)
     return jnp.concatenate([lo, hi], axis=1)  # [ng, G, N]
 
 

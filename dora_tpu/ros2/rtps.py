@@ -15,12 +15,23 @@ discovery + best-effort data subset the bridge needs:
 * **SEDP** — endpoint discovery: ``DATA(w)`` / ``DATA(r)`` publication
   and subscription announcements (topic, type, user-traffic locator)
   unicast to each discovered participant's metatraffic locator.
-* **User data** — best-effort ``DATA`` submessages with CDR_LE
-  payloads sent straight to every matched reader's user locator.
+* **User data** — ``DATA`` submessages with CDR_LE payloads sent
+  straight to every matched reader's user locator.
+* **Reliable QoS** (round 5) — writers opened with ``reliable=True``
+  keep a keep-last history and advertise RELIABLE reliability in SEDP;
+  they append a piggyback ``HEARTBEAT`` to every DATA and repeat it
+  from the announce loop. Reliable readers deliver IN ORDER per remote
+  writer, buffer out-of-sequence arrivals, answer heartbeats with
+  ``ACKNACK`` bitmaps of the missing sequence numbers, and honor
+  ``GAP`` (a writer's statement that evicted-from-history sequences
+  will never arrive). Loss recovery is asserted under an injected-loss
+  socket shim dropping every k-th DATA (tests/test_ros2_rtps.py).
+* **Lease expiry** — peers advertise their SPDP lease duration
+  (``DORA_RTPS_LEASE_S``); a participant that stops announcing is
+  dropped — with its endpoints — once its lease runs out, matching the
+  reference stack's participant liveliness semantics.
 
-Reliable QoS (HEARTBEAT/ACKNACK/GAP) is NOT implemented — matching the
-bridge's sensor-stream usage (best-effort, keep-last). Messages use
-the standard ROS2 mangling (topic ``rt/<name>``, type
+Messages use the standard ROS2 mangling (topic ``rt/<name>``, type
 ``pkg::msg::dds_::Type_``) so the frames are what any DDS stack
 expects; cross-vendor interop cannot be exercised in this offline
 image (no other DDS exists here) and is documented as such in
@@ -46,6 +57,9 @@ VENDOR = b"\x01\x21"  # unassigned range; parsers must accept any vendor
 # Submessage ids
 _INFO_TS = 0x09
 _DATA = 0x15
+_ACKNACK = 0x06
+_HEARTBEAT = 0x07
+_GAP = 0x08
 
 # Builtin entity ids (RTPS 2.3 table 9.2)
 ENT_SPDP_W = 0x000100C2
@@ -135,6 +149,7 @@ class _Peer:
     meta: tuple[str, int]
     seen: float = 0.0
     sedp_sent: bool = False
+    lease_s: float = 100.0
 
 
 @dataclass
@@ -143,6 +158,7 @@ class _RemoteEndpoint:
     topic: str
     type_name: str
     locator: tuple[str, int] | None
+    reliable: bool = False
 
 
 @dataclass
@@ -151,6 +167,23 @@ class _Writer:
     topic: str
     type_name: str
     seq: int = 0
+    reliable: bool = False
+    #: keep-last history for reliable resend: seq -> encapsulated payload
+    store: dict = field(default_factory=dict)
+    depth: int = 32
+    hb_count: int = 0
+    #: per-reader-guid last processed ACKNACK count (stale-drop)
+    acked: dict = field(default_factory=dict)
+
+
+@dataclass
+class _WriterProxy:
+    """Reliable reception state for one remote writer."""
+
+    next_seq: int = 1  # next sequence to deliver in order
+    pending: dict = field(default_factory=dict)  # seq -> payload | None(gap)
+    last_hb_count: int = -1
+    acknack_count: int = 0
 
 
 @dataclass
@@ -160,6 +193,8 @@ class _Reader:
     type_name: str
     callback: object = None
     history: list = field(default_factory=list)
+    reliable: bool = False
+    proxies: dict = field(default_factory=dict)  # writer guid -> _WriterProxy
 
 
 class RtpsParticipant:
@@ -182,6 +217,12 @@ class RtpsParticipant:
         self._next_entity = 1
         self._lock = threading.RLock()
         self._closed = threading.Event()
+        #: advertised SPDP lease (peers drop us this long after our last
+        #: announcement); tests shrink it to exercise expiry.
+        self.lease_s = float(os.environ.get("DORA_RTPS_LEASE_S", "100"))
+        #: optional (dest, submsgs) -> bool keep hook — the loss-injection
+        #: shim of the reliable-protocol tests.
+        self.send_filter = None
 
         mcast_port, ucast_base = _ports(domain_id)
         # Metatraffic unicast: the spec's well-known ports so unicast
@@ -276,10 +317,69 @@ class RtpsParticipant:
         return struct.pack("<BBH", _DATA, flags, len(body)) + body
 
     def _send(self, dest: tuple[str, int], submsgs: bytes) -> None:
+        if self.send_filter is not None and not self.send_filter(
+            dest, submsgs
+        ):
+            return  # test shim: injected packet loss
         try:
             self._send_sock.sendto(self._header() + submsgs, dest)
         except OSError:
             pass
+
+    @staticmethod
+    def _sn(seq: int) -> bytes:
+        return struct.pack("<iI", seq >> 32, seq & 0xFFFFFFFF)
+
+    @staticmethod
+    def _parse_sn(body: bytes, off: int) -> int:
+        high, low = struct.unpack_from("<iI", body, off)
+        return (high << 32) | low
+
+    def _heartbeat_submsg(self, reader_ent: int, writer: "_Writer",
+                          final: bool = False) -> bytes:
+        # Called from both the app thread (publish piggyback) and the
+        # announce thread (periodic sweep): the store read and count
+        # bump must not race publish_cdr's locked history mutation.
+        with self._lock:
+            writer.hb_count += 1
+            first = min(writer.store) if writer.store else max(writer.seq, 1)
+            last = writer.seq
+        flags = 0x01 | (0x02 if final else 0)
+        body = (
+            struct.pack(">II", reader_ent, writer.entity_id)
+            + self._sn(first)
+            + self._sn(last)
+            + struct.pack("<i", writer.hb_count)
+        )
+        return struct.pack("<BBH", _HEARTBEAT, flags, len(body)) + body
+
+    def _acknack_submsg(self, reader_ent: int, writer_ent: int, base: int,
+                        missing: list[int], count: int) -> bytes:
+        num_bits = (max(missing) - base + 1) if missing else 0
+        words = [0] * ((num_bits + 31) // 32)
+        for s in missing:
+            i = s - base
+            words[i // 32] |= 1 << (31 - i % 32)  # RTPS bitmap: MSB first
+        body = (
+            struct.pack(">II", reader_ent, writer_ent)
+            + self._sn(base)
+            + struct.pack("<I", num_bits)
+            + b"".join(struct.pack("<I", w) for w in words)
+            + struct.pack("<i", count)
+        )
+        flags = 0x01 | (0x00 if missing else 0x02)  # final when nothing asked
+        return struct.pack("<BBH", _ACKNACK, flags, len(body)) + body
+
+    def _gap_submsg(self, reader_ent: int, writer_ent: int,
+                    start: int, end: int) -> bytes:
+        """GAP covering [start, end] (irrelevant sequences)."""
+        body = (
+            struct.pack(">II", reader_ent, writer_ent)
+            + self._sn(start)
+            + self._sn(end + 1)  # gapList base: first seq NOT in the gap
+            + struct.pack("<I", 0)  # numBits 0: no extra bits
+        )
+        return struct.pack("<BBH", _GAP, 0x01, len(body)) + body
 
     # -- announcements ------------------------------------------------------
 
@@ -299,7 +399,13 @@ class RtpsParticipant:
                     _locator(self._local_addr(), self.user_port),
                 ),
                 _param(PID_BUILTIN_ENDPOINTS, struct.pack("<I", 0x0000000F)),
-                _param(PID_LEASE, struct.pack("<iI", 100, 0)),
+                _param(
+                    PID_LEASE,
+                    struct.pack(
+                        "<iI", int(self.lease_s),
+                        int((self.lease_s % 1) * (1 << 32)),
+                    ),
+                ),
                 _param(PID_SENTINEL, b""),
             ]
         )
@@ -311,10 +417,13 @@ class RtpsParticipant:
         return "127.0.0.1"
 
     def _sedp_payload(self, topic: str, type_name: str, guid_ent: int,
-                      locator_port: int) -> bytes:
+                      locator_port: int, reliable: bool = False) -> bytes:
         from dora_tpu.ros2.cdr import PL_CDR_LE
 
         guid = self.guid_prefix + struct.pack(">I", guid_ent)
+        # Reliability kind: 1 = BEST_EFFORT, 2 = RELIABLE (+100 ms
+        # max_blocking_time, the common DDS default).
+        kind = 2 if reliable else 1
         params = b"".join(
             [
                 _param_string(PID_TOPIC_NAME, topic),
@@ -325,8 +434,9 @@ class RtpsParticipant:
                     _locator(self._local_addr(), locator_port),
                 ),
                 _param(
-                    PID_RELIABILITY, struct.pack("<iiI", 1, 0, 0)
-                ),  # best-effort
+                    PID_RELIABILITY,
+                    struct.pack("<iiI", kind, 0, 100_000_000),
+                ),
                 _param(PID_SENTINEL, b""),
             ]
         )
@@ -352,7 +462,47 @@ class RtpsParticipant:
             for dest in dests:
                 self._send(dest, spdp)
             self._sedp_announce()
+            self._expire_peers()
+            self._heartbeat_sweep()
             self._closed.wait(self.ANNOUNCE_PERIOD_S)
+
+    def _expire_peers(self) -> None:
+        """Drop peers (and their endpoints) whose SPDP lease ran out —
+        the participant-liveliness semantics of the reference's DDS
+        stack (a crashed peer's endpoints must unmatch)."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [
+                guid for guid, p in self._peers.items()
+                if p.seen and now - p.seen > p.lease_s
+            ]
+            for guid in dead:
+                del self._peers[guid]
+                for table in (self._remote_writers, self._remote_readers):
+                    for ep_guid in [g for g in table if g[:12] == guid]:
+                        del table[ep_guid]
+                # Reliable-protocol state keyed by the dead peer's
+                # endpoints must go too (peer churn must not leak
+                # buffered payloads or acknack bookkeeping).
+                for r in self._readers.values():
+                    for wg in [g for g in r.proxies if g[:12] == guid]:
+                        del r.proxies[wg]
+                for w in self._writers.values():
+                    for rg in [g for g in w.acked if g[:12] == guid]:
+                        del w.acked[rg]
+
+    def _heartbeat_sweep(self) -> None:
+        """Periodic HEARTBEAT for every reliable writer with history —
+        the retransmission clock: a reader that missed a DATA (and its
+        piggyback heartbeat) learns what it lacks from this."""
+        with self._lock:
+            writers = [w for w in self._writers.values()
+                       if w.reliable and w.seq]
+        for w in writers:
+            hb = self._heartbeat_submsg(0, w)
+            for ep in self.matched_readers(w.topic):
+                if ep.reliable:
+                    self._send(ep.locator, hb)
 
     def _sedp_announce(self) -> None:
         with self._lock:
@@ -363,14 +513,16 @@ class RtpsParticipant:
             msgs = b""
             for i, w in enumerate(writers):
                 payload = self._sedp_payload(
-                    w.topic, w.type_name, w.entity_id, self.user_port
+                    w.topic, w.type_name, w.entity_id, self.user_port,
+                    reliable=w.reliable,
                 )
                 msgs += self._data_submsg(
                     ENT_SEDP_PUB_R, ENT_SEDP_PUB_W, i + 1, payload
                 )
             for i, r in enumerate(readers):
                 payload = self._sedp_payload(
-                    r.topic, r.type_name, r.entity_id, self.user_port
+                    r.topic, r.type_name, r.entity_id, self.user_port,
+                    reliable=r.reliable,
                 )
                 msgs += self._data_submsg(
                     ENT_SEDP_SUB_R, ENT_SEDP_SUB_W, i + 1, payload
@@ -407,10 +559,20 @@ class RtpsParticipant:
                 return  # big-endian peers unsupported (none in practice)
             body = data[pos + 4 : pos + 4 + length]
             pos += 4 + length
+            if sub_id == _HEARTBEAT and len(body) >= 28:
+                self._on_heartbeat(src_prefix, body)
+                continue
+            if sub_id == _ACKNACK and len(body) >= 24:
+                self._on_acknack(src_prefix, body)
+                continue
+            if sub_id == _GAP and len(body) >= 28:
+                self._on_gap(src_prefix, body)
+                continue
             if sub_id != _DATA or len(body) < 24:
                 continue
             _extra, to_qos = struct.unpack_from("<HH", body, 0)
             reader_ent, writer_ent = struct.unpack_from(">II", body, 4)
+            seq = self._parse_sn(body, 12)
             # octetsToInlineQos counts from the octet after itself
             # (i.e. from body offset 4) to the inline-qos/payload.
             payload = body[4 + to_qos :]
@@ -422,27 +584,34 @@ class RtpsParticipant:
                 self._on_sedp(src_prefix, payload, is_writer=False)
             else:
                 self._on_user_data(src_prefix, writer_ent, reader_ent,
-                                   payload)
+                                   payload, seq)
 
     def _on_spdp(self, payload: bytes) -> None:
         if len(payload) < 4:
             return
         params = _parse_params(payload[4:])
         guid = meta = None
+        lease_s = 100.0
         for pid, value in params:
             if pid == PID_PARTICIPANT_GUID and len(value) >= 12:
                 guid = value[:12]
             elif pid == PID_METATRAFFIC_UNICAST_LOCATOR and len(value) >= 24:
                 meta = _parse_locator(value)
+            elif pid == PID_LEASE and len(value) >= 8:
+                sec, frac = struct.unpack_from("<iI", value, 0)
+                lease_s = sec + frac / (1 << 32)
         if guid is None or meta is None or guid == self.guid_prefix:
             return
         with self._lock:
             peer = self._peers.get(guid)
             if peer is None:
-                self._peers[guid] = _Peer(guid, meta, time.monotonic())
+                self._peers[guid] = _Peer(
+                    guid, meta, time.monotonic(), lease_s=lease_s
+                )
             else:
                 peer.meta = meta
                 peer.seen = time.monotonic()
+                peer.lease_s = lease_s
 
     def _on_sedp(self, src_prefix: bytes, payload: bytes,
                  is_writer: bool) -> None:
@@ -452,6 +621,7 @@ class RtpsParticipant:
         topic = type_name = None
         guid = None
         locator = None
+        reliable = False
         for pid, value in params:
             if pid == PID_TOPIC_NAME:
                 topic = _param_str_value(value)
@@ -461,9 +631,12 @@ class RtpsParticipant:
                 guid = value
             elif pid in (PID_UNICAST_LOCATOR, PID_DEFAULT_UNICAST_LOCATOR):
                 locator = _parse_locator(value) or locator
+            elif pid == PID_RELIABILITY and len(value) >= 4:
+                reliable = struct.unpack_from("<i", value, 0)[0] >= 2
         if not topic or guid is None:
             return
-        ep = _RemoteEndpoint(guid, topic, type_name or "", locator)
+        ep = _RemoteEndpoint(guid, topic, type_name or "", locator,
+                             reliable=reliable)
         with self._lock:
             if is_writer:
                 self._remote_writers[guid] = ep
@@ -471,8 +644,11 @@ class RtpsParticipant:
                 self._remote_readers[guid] = ep
 
     def _on_user_data(self, src_prefix: bytes, writer_ent: int,
-                      reader_ent: int, payload: bytes) -> None:
-        """Route a user DATA to local readers on the writer's topic."""
+                      reader_ent: int, payload: bytes, seq: int = 0) -> None:
+        """Route a user DATA to local readers on the writer's topic.
+        Reliable readers deliver IN ORDER per remote writer: early
+        arrivals buffer until the gap fills (retransmission) or a GAP
+        declares it irrelevant."""
         writer_guid = src_prefix + struct.pack(">I", writer_ent)
         with self._lock:
             ep = self._remote_writers.get(writer_guid)
@@ -483,30 +659,161 @@ class RtpsParticipant:
             return
         body = payload[4:]  # strip encapsulation header
         for r in readers:
-            if r.topic == ep.topic:
-                if r.callback is not None:
-                    r.callback(body)
-                else:
-                    r.history.append(body)
+            if r.topic != ep.topic:
+                continue
+            if not (r.reliable and ep.reliable):
+                self._deliver(r, body)
+                continue
+            with self._lock:
+                proxy = r.proxies.setdefault(writer_guid, _WriterProxy())
+                if seq < proxy.next_seq or seq in proxy.pending:
+                    continue  # duplicate (retransmission overlap)
+                proxy.pending[seq] = body
+                self._drain_proxy(r, proxy)
+
+    def _deliver(self, reader: "_Reader", body: bytes) -> None:
+        if reader.callback is not None:
+            reader.callback(body)
+        else:
+            reader.history.append(body)
+
+    def _drain_proxy(self, reader: "_Reader", proxy: "_WriterProxy") -> None:
+        """Deliver the contiguous run at the head of the pending buffer
+        (None entries are GAP-declared irrelevant sequences)."""
+        while proxy.next_seq in proxy.pending:
+            body = proxy.pending.pop(proxy.next_seq)
+            proxy.next_seq += 1
+            if body is not None:
+                self._deliver(reader, body)
+
+    # -- reliable protocol ---------------------------------------------------
+
+    def _on_heartbeat(self, src_prefix: bytes, body: bytes) -> None:
+        """Answer a writer's HEARTBEAT with an ACKNACK naming exactly
+        the sequences this reader still lacks in [first, last]."""
+        reader_ent, writer_ent = struct.unpack_from(">II", body, 0)
+        first = self._parse_sn(body, 8)
+        last = self._parse_sn(body, 16)
+        (count,) = struct.unpack_from("<i", body, 24)
+        writer_guid = src_prefix + struct.pack(">I", writer_ent)
+        with self._lock:
+            ep = self._remote_writers.get(writer_guid)
+            if ep is None or not ep.reliable or ep.locator is None:
+                return
+            targets = [
+                r for r in self._readers.values()
+                if r.topic == ep.topic and r.reliable
+            ]
+            for r in targets:
+                proxy = r.proxies.setdefault(writer_guid, _WriterProxy())
+                if count <= proxy.last_hb_count:
+                    continue  # stale repeat
+                proxy.last_hb_count = count
+                # Sequences below `first` left the writer's history:
+                # the truly-missing ones are unrecoverable (skip), but
+                # anything already buffered out-of-order DID arrive and
+                # must still be delivered, in order.
+                while proxy.next_seq < first:
+                    body = proxy.pending.pop(proxy.next_seq, None)
+                    proxy.next_seq += 1
+                    if body is not None:
+                        self._deliver(r, body)
+                self._drain_proxy(r, proxy)
+                missing = [
+                    s for s in range(proxy.next_seq, last + 1)
+                    if s not in proxy.pending
+                ]
+                proxy.acknack_count += 1
+                ack = self._acknack_submsg(
+                    r.entity_id, writer_ent,
+                    missing[0] if missing else last + 1,
+                    missing, proxy.acknack_count,
+                )
+                self._send(ep.locator, ack)
+
+    def _on_acknack(self, src_prefix: bytes, body: bytes) -> None:
+        """Resend requested sequences from history; GAP the evicted."""
+        reader_ent, writer_ent = struct.unpack_from(">II", body, 0)
+        base = self._parse_sn(body, 8)
+        (num_bits,) = struct.unpack_from("<I", body, 16)
+        words = [
+            struct.unpack_from("<I", body, 20 + 4 * i)[0]
+            for i in range((num_bits + 31) // 32)
+        ]
+        (count,) = struct.unpack_from(
+            "<i", body, 20 + 4 * len(words)
+        )
+        requested = [
+            base + i
+            for i in range(num_bits)
+            if words[i // 32] & (1 << (31 - i % 32))
+        ]
+        reader_guid = src_prefix + struct.pack(">I", reader_ent)
+        with self._lock:
+            w = self._writers.get(writer_ent)
+            if w is None or not w.reliable:
+                return
+            if count <= w.acked.get(reader_guid, -1):
+                return  # stale repeat
+            w.acked[reader_guid] = count
+            ep = self._remote_readers.get(reader_guid)
+            store = dict(w.store)
+        if ep is None or ep.locator is None:
+            return
+        for s in requested:
+            payload = store.get(s)
+            if payload is not None:
+                self._send(
+                    ep.locator,
+                    self._data_submsg(reader_ent, writer_ent, s, payload),
+                )
+            else:
+                # Evicted from keep-last history: tell the reader to
+                # stop waiting for it.
+                self._send(
+                    ep.locator,
+                    self._gap_submsg(reader_ent, writer_ent, s, s),
+                )
+
+    def _on_gap(self, src_prefix: bytes, body: bytes) -> None:
+        """Mark [gapStart, gapListBase) as irrelevant for this writer."""
+        reader_ent, writer_ent = struct.unpack_from(">II", body, 0)
+        start = self._parse_sn(body, 8)
+        list_base = self._parse_sn(body, 16)
+        writer_guid = src_prefix + struct.pack(">I", writer_ent)
+        with self._lock:
+            ep = self._remote_writers.get(writer_guid)
+            if ep is None:
+                return
+            for r in self._readers.values():
+                if r.topic != ep.topic or not r.reliable:
+                    continue
+                proxy = r.proxies.setdefault(writer_guid, _WriterProxy())
+                for s in range(max(start, proxy.next_seq), list_base):
+                    proxy.pending.setdefault(s, None)
+                self._drain_proxy(r, proxy)
 
     # -- public API ---------------------------------------------------------
 
-    def create_writer(self, topic: str, msg_type: str) -> "RtpsWriter":
+    def create_writer(self, topic: str, msg_type: str,
+                      reliable: bool = False,
+                      history_depth: int = 32) -> "RtpsWriter":
         with self._lock:
             ent = (self._next_entity << 8) | 0x03  # user writer, no key
             self._next_entity += 1
-            w = _Writer(ent, _mangle_topic(topic), _mangle_type(msg_type))
+            w = _Writer(ent, _mangle_topic(topic), _mangle_type(msg_type),
+                        reliable=reliable, depth=history_depth)
             self._writers[ent] = w
         self._sedp_announce()
         return RtpsWriter(self, w)
 
     def create_reader(self, topic: str, msg_type: str,
-                      callback=None) -> "_Reader":
+                      callback=None, reliable: bool = False) -> "_Reader":
         with self._lock:
             ent = (self._next_entity << 8) | 0x04  # user reader, no key
             self._next_entity += 1
             r = _Reader(ent, _mangle_topic(topic), _mangle_type(msg_type),
-                        callback)
+                        callback, reliable=reliable)
             self._readers[ent] = r
         self._sedp_announce()
         return r
@@ -546,12 +853,24 @@ class RtpsWriter:
         self._w = writer
 
     def publish_cdr(self, cdr_bytes: bytes) -> None:
-        """Send an already-CDR-encoded payload to every matched reader."""
+        """Send an already-CDR-encoded payload to every matched reader.
+        Reliable writers store the sample in keep-last history and
+        piggyback a HEARTBEAT so readers detect loss immediately."""
         from dora_tpu.ros2.cdr import CDR_LE
 
-        self._w.seq += 1
-        submsg = self._p._data_submsg(
-            0, self._w.entity_id, self._w.seq, CDR_LE + cdr_bytes
-        )
-        for ep in self._p.matched_readers(self._w.topic):
-            self._p._send(ep.locator, submsg)
+        w = self._w
+        payload = CDR_LE + cdr_bytes
+        with self._p._lock:
+            w.seq += 1
+            seq = w.seq
+            if w.reliable:
+                w.store[seq] = payload
+                while len(w.store) > w.depth:
+                    del w.store[min(w.store)]
+        submsg = self._p._data_submsg(0, w.entity_id, seq, payload)
+        hb = self._p._heartbeat_submsg(0, w) if w.reliable else b""
+        for ep in self._p.matched_readers(w.topic):
+            if w.reliable and ep.reliable:
+                self._p._send(ep.locator, submsg + hb)
+            else:
+                self._p._send(ep.locator, submsg)

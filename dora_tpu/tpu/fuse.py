@@ -217,6 +217,33 @@ def mesh_from_env():
     return make_mesh(**axes)
 
 
+def _fetch(value):
+    """The one device→host transfer point of the pipelined executor —
+    kept as a module hook so tests can inject tunnel latency."""
+    import numpy as np
+
+    return np.asarray(value)
+
+
+def fetch_every_from_env() -> int:
+    """Frames per device→host fetch (DORA_FETCH_EVERY, default 1).
+
+    The round-4 drift analysis (KNOWN_ISSUES.md) showed serving FPS is
+    hostage to fetch latency: every tick pays one device→host round
+    trip, and concurrent fetches only amortize it to ~RTT/depth. With
+    N > 1, tick outputs accumulate ON DEVICE (a jnp.stack ring) and one
+    fetch moves N frames — per-frame fetch cost drops to ~RTT/N plus a
+    few bytes of copy, decoupling steady-state FPS from the tunnel's
+    latency term entirely. Outputs arrive in bursts of N (up to N-1
+    frames of added latency): a serving-throughput config for
+    continuous streams, not for request/response flows — hence opt-in.
+    A partial group flushes after DORA_FETCH_LINGER_MS (default 100) so
+    sporadic streams never stall."""
+    import os
+
+    return max(1, int(os.environ.get("DORA_FETCH_EVERY", "1")))
+
+
 def pipeline_depth_from_env() -> int:
     """In-flight tick budget (DORA_PIPELINE_DEPTH). Default 4 on
     accelerators: JAX dispatch is asynchronous, so in-flight ticks
@@ -251,7 +278,8 @@ class FusedExecutor:
     the next frame (BASELINE.md north star; the round-2 serial loop spent
     ~90 ms/frame of tunnel RTT doing exactly that)."""
 
-    def __init__(self, graph: FusedGraph, mesh=None, pipeline_depth=None):
+    def __init__(self, graph: FusedGraph, mesh=None, pipeline_depth=None,
+                 fetch_every=None):
         import jax
 
         self.graph = graph
@@ -286,9 +314,28 @@ class FusedExecutor:
                 self.states[op_id] = jax.device_put(op.init_state)
         #: latest device value per external data input (latest-wins sampling)
         self.latest: dict[str, Any] = {}
-        #: futures of in-flight tick emissions, oldest first
+        #: futures of in-flight tick emissions, oldest first; each future
+        #: resolves to a LIST of tick-output dicts (fetch groups)
         self._in_flight: list[Any] = []
         self._fetch_pool = None
+        #: device-side output ring: tick outputs staged for the next
+        #: grouped fetch (fetch_every > 1 — see fetch_every_from_env)
+        self.fetch_every = (
+            fetch_every_from_env() if fetch_every is None else fetch_every
+        )
+        if self.eager:
+            self.fetch_every = 1
+        self._staged: list[dict] = []
+        self._linger_s = (
+            float(__import__("os").environ.get("DORA_FETCH_LINGER_MS", "100"))
+            / 1000.0
+        )
+        self._linger_timer = None
+        # The linger timer flushes from its own thread; staging and
+        # group submission must not race it.
+        import threading
+
+        self._stage_lock = threading.Lock()
         if self.pipeline_depth > 0:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -374,7 +421,9 @@ class FusedExecutor:
     def on_event_async(self, event_id: str, value, metadata: dict | None) -> None:
         """Pipelined on_event: dispatch the tick without fetching. The new
         state chains on-device behind the in-flight computation; results
-        are picked up by :meth:`harvest`."""
+        are picked up by :meth:`harvest`. With ``fetch_every`` > 1 the
+        outputs stage in a device-side ring and N ticks share ONE
+        device→host fetch."""
         self.observe(event_id, value, metadata)
         if event_id not in self.graph.trigger_inputs:
             return
@@ -382,42 +431,120 @@ class FusedExecutor:
             return
         self.states, outputs = self._jit(self.states, dict(self.latest))
         self._compiled_once = True
-        # The fetch starts NOW on its own thread; the event loop never
-        # blocks in a device→host copy while the queue has headroom.
-        future = self._fetch_pool.submit(self._emit, outputs)
+        with self._stage_lock:
+            self._staged.append(outputs)
+            if len(self._staged) >= self.fetch_every:
+                self._submit_group_locked()
+            elif self._linger_timer is None:
+                # Partial group: guarantee a flush even if no further
+                # tick arrives (sporadic streams must not stall N-1
+                # frames).
+                import threading
+
+                self._linger_timer = threading.Timer(
+                    self._linger_s, self._linger_flush
+                )
+                self._linger_timer.daemon = True
+                self._linger_timer.start()
+        # Backpressure: bound in-flight TICKS (and their HBM) by waiting
+        # out the oldest fetch. The bound is pipeline_depth ticks of
+        # unfetched output plus the group currently staging (a resolved
+        # future's buffers are already on host). The waited result is
+        # not dropped — it stays queued for the next harvest in order.
+        limit = self.pipeline_depth + self.fetch_every - 1
+        while self._unfetched_ticks() > limit:
+            oldest = next(
+                (f for f in self._in_flight if not f.done()), None
+            )
+            if oldest is None:
+                break
+            oldest.result()
+
+    def _unfetched_ticks(self) -> int:
+        with self._stage_lock:
+            pending = sum(
+                getattr(f, "dora_ticks", 1)
+                for f in self._in_flight
+                if not f.done()
+            )
+            return pending + len(self._staged)
+
+    def _submit_group(self) -> None:
+        with self._stage_lock:
+            self._submit_group_locked()
+
+    def _submit_group_locked(self) -> None:
+        """Move the staged ring into one fetch job. The per-output stack
+        happens here (an async device op); the worker thread then pays a
+        single device→host round trip for all staged ticks."""
+        if not self._staged:
+            return
+        timer, self._linger_timer = self._linger_timer, None
+        if timer is not None:
+            timer.cancel()
+        staged, self._staged = self._staged, []
+        if len(staged) == 1:
+            payload = staged[0]
+        else:
+            import jax.numpy as jnp
+
+            payload = {
+                key: jnp.stack([tick[key] for tick in staged])
+                for key in staged[0]
+            }
+        future = self._fetch_pool.submit(self._emit, payload, len(staged))
+        future.dora_ticks = len(staged)
         self._in_flight.append(future)
         if self.on_fetch_done is not None:
             future.add_done_callback(lambda _f: self.on_fetch_done())
-        if len(self._in_flight) > self.pipeline_depth:
-            # Backpressure: bound in-flight ticks (and their HBM) by
-            # waiting out the oldest fetch. Its result is not dropped —
-            # it stays queued for the next harvest in order.
-            self._in_flight[0].result()
 
-    def _emit(self, outputs: dict) -> dict:
+    def _linger_flush(self) -> None:
+        with self._stage_lock:
+            self._linger_timer = None
+            self._submit_group_locked()
+
+    def _emit(self, outputs: dict, n_ticks: int = 1) -> list[dict]:
         from dora_tpu.tpu.bridge import device_to_arrow
 
-        return {
-            out_id: device_to_arrow(value) for out_id, value in outputs.items()
-        }
+        # The device→host transfer goes through the module-level _fetch
+        # hook (tests inject tunnel latency there); the Arrow conversion
+        # below then runs on host arrays at zero device cost.
+        host = {out_id: _fetch(v) for out_id, v in outputs.items()}
+        if n_ticks == 1:
+            return [
+                {out_id: device_to_arrow(v) for out_id, v in host.items()}
+            ]
+        # ONE fetch per output id moved all n_ticks frames; the split
+        # back into per-tick frames is host-side numpy slicing.
+        return [
+            {out_id: device_to_arrow(v[i]) for out_id, v in host.items()}
+            for i in range(n_ticks)
+        ]
 
     @property
     def has_in_flight(self) -> bool:
-        return bool(self._in_flight)
+        return bool(self._in_flight) or bool(self._staged)
 
     def harvest(self, block: bool = False) -> list[dict]:
         """Completed tick outputs in dispatch order. Non-blocking by
         default: drains the queue head while its fetch has finished.
-        ``block`` waits for everything (stream-end flush)."""
+        ``block`` waits for everything (stream-end flush), including a
+        partially filled fetch group."""
+        if block:
+            self._submit_group()
         done: list[dict] = []
         while self._in_flight and (block or self._in_flight[0].done()):
-            done.append(self._in_flight.pop(0).result())
+            done.extend(self._in_flight.pop(0).result())
         return done
 
     def close(self) -> None:
         """Release the fetch pool. Call after the stream-end flush
         (``harvest(block=True)``); any still-queued fetches are drained
         so their device buffers are not abandoned mid-copy."""
+        with self._stage_lock:
+            timer, self._linger_timer = self._linger_timer, None
+        if timer is not None:
+            timer.cancel()
         if self._fetch_pool is not None:
             for future in self._in_flight:
                 try:

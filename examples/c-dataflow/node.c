@@ -1,0 +1,37 @@
+// Pure-C source node (reference: examples/c-dataflow/node.c) — drives
+// the dataflow off daemon timer ticks: each tick publishes one random
+// byte through the C node API.
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "dora_node_api.h"
+
+int main(void) {
+  DoraContext* ctx = dora_init_from_env();
+  if (ctx == NULL) {
+    fprintf(stderr, "dora_init_from_env failed\n");
+    return 1;
+  }
+  srand(42);
+  int sent = 0;
+  DoraEvent* event;
+  while ((event = dora_next_event(ctx)) != NULL) {
+    DoraEventType type = dora_event_type(event);
+    if (type == DORA_EVENT_STOP) {
+      dora_event_free(ctx, event);
+      break;
+    }
+    if (type == DORA_EVENT_INPUT) {
+      unsigned char value = (unsigned char)(rand() % 100);
+      if (dora_send_output(ctx, "counter", &value, 1) != 0) {
+        fprintf(stderr, "send failed: %s\n", dora_last_error(ctx));
+      }
+      sent++;
+    }
+    dora_event_free(ctx, event);
+    if (sent >= 20) break;
+  }
+  fprintf(stderr, "c node sent %d values\n", sent);
+  dora_close(ctx);
+  return sent > 0 ? 0 : 1;
+}

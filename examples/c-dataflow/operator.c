@@ -1,0 +1,36 @@
+// Pure-C operator (reference: examples/c-dataflow/operator.c) — runs
+// inside the shared runtime through the C ABI: sums incoming bytes and
+// republishes the running total as a formatted string.
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "dora_operator_api.h"
+
+typedef struct {
+  unsigned long long total;
+  int events;
+} CounterState;
+
+void* dora_init_operator(void) {
+  CounterState* state = calloc(1, sizeof(CounterState));
+  return state;
+}
+
+void dora_drop_operator(void* state) { free(state); }
+
+int dora_on_event(void* raw_state, const DoraOperatorEvent* event,
+                  const DoraOperatorSendOutput* send_output) {
+  CounterState* state = (CounterState*)raw_state;
+  if (event->type == DORA_OP_EVENT_STOP) return DORA_OP_CONTINUE;
+  if (event->type != DORA_OP_EVENT_INPUT || event->data_len == 0)
+    return DORA_OP_CONTINUE;
+  state->total += event->data[0];
+  state->events++;
+  char message[64];
+  int n = snprintf(message, sizeof(message), "sum=%llu after %d",
+                   state->total, state->events);
+  send_output->send(send_output->context, "status",
+                    (const unsigned char*)message, (size_t)n, "raw");
+  return DORA_OP_CONTINUE;
+}

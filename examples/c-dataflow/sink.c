@@ -1,0 +1,28 @@
+// Pure-C sink (reference: examples/c-dataflow/sink.c) — prints every
+// status line; exits nonzero if nothing arrived.
+#include <stdio.h>
+
+#include "dora_node_api.h"
+
+int main(void) {
+  DoraContext* ctx = dora_init_from_env();
+  if (ctx == NULL) return 1;
+  int received = 0;
+  DoraEvent* event;
+  while ((event = dora_next_event(ctx)) != NULL) {
+    if (dora_event_type(event) == DORA_EVENT_STOP) {
+      dora_event_free(ctx, event);
+      break;
+    }
+    if (dora_event_type(event) == DORA_EVENT_INPUT) {
+      size_t len = 0;
+      const unsigned char* data = dora_event_data(event, &len);
+      printf("c sink: %.*s\n", (int)len, (const char*)data);
+      received++;
+    }
+    dora_event_free(ctx, event);
+  }
+  fprintf(stderr, "c sink received %d\n", received);
+  dora_close(ctx);
+  return received > 0 ? 0 : 1;
+}

@@ -1,0 +1,74 @@
+"""Run dataflow_dynamic.yml and attach the receiver from OUTSIDE the
+daemon (reference: examples/rust-dataflow dataflow_dynamic.yml +
+`cargo run -p rust-dataflow-example-sink-dynamic`): the dynamic node
+connects with NODE_ID + DORA_DAEMON_ADDR while the daemon holds the
+start barrier for it."""
+
+import asyncio
+import os
+import sys
+import textwrap
+from pathlib import Path
+
+HERE = Path(__file__).parent
+REPO = HERE.parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+os.environ["PYTHONPATH"] = (
+    f"{REPO}{os.pathsep}{os.environ.get('PYTHONPATH', '')}"
+)
+
+RECEIVER = textwrap.dedent("""
+    import os
+
+    from dora_tpu.node import Node
+
+    got = []
+    with Node(node_id=os.environ["NODE_ID"],
+              daemon_addr=os.environ["DORA_DAEMON_ADDR"]) as node:
+        for event in node:
+            if event["type"] == "INPUT":
+                got.append(event["value"].to_pylist())
+    assert got and got[0] == [1, 2, 3], got
+    print(f"dynamic receiver got {len(got)} messages", flush=True)
+""")
+
+
+async def main() -> None:
+    from dora_tpu.core.descriptor import Descriptor
+    from dora_tpu.daemon.core import Daemon
+
+    daemon = Daemon(local_comm="tcp")
+    await daemon.start()
+    try:
+        descriptor = Descriptor.read(HERE / "dataflow_dynamic.yml")
+        df = await daemon.spawn_dataflow(
+            descriptor, working_dir=HERE,
+            local_nodes={"sender", "relay", "receiver"},
+        )
+        script = HERE / "_dynamic_receiver.py"
+        script.write_text(RECEIVER)
+        env = dict(os.environ)
+        env.update(
+            NODE_ID="receiver",
+            DORA_DAEMON_ADDR=f"127.0.0.1:{daemon.dynamic_port}",
+        )
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, str(script), env=env, cwd=HERE,
+        )
+        result = await asyncio.wait_for(asyncio.shield(df.done), 120)
+        await asyncio.wait_for(proc.wait(), 15)
+        script.unlink(missing_ok=True)
+        if not result.is_ok():
+            raise SystemExit(f"dataflow failed: {result.errors()}")
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"dynamic receiver failed (rc={proc.returncode})"
+            )
+        print("dynamic dataflow finished successfully")
+    finally:
+        await daemon.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

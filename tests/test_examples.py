@@ -80,3 +80,49 @@ def test_cmake_dataflow_example():
     )
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     assert "finished successfully" in proc.stdout
+
+
+def test_c_dataflow_example():
+    """Pure-C dataflow: C source node + C operator (shared runtime, C
+    ABI) + C sink, built by `dora-tpu build` (reference
+    examples/c-dataflow)."""
+    df = REPO / "examples" / "c-dataflow" / "dataflow.yml"
+    build = subprocess.run(
+        [sys.executable, "-m", "dora_tpu.cli.main", "build", str(df)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, f"{build.stdout}\n{build.stderr}"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dora_tpu.cli.main", "daemon",
+            "--run-dataflow", str(df),
+        ],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "finished successfully" in proc.stdout
+    out = REPO / "examples" / "c-dataflow" / "out"
+    logs = sorted(out.glob("*/log_c_sink.txt"), key=lambda p: p.stat().st_mtime)
+    assert logs and "sum=" in logs[-1].read_text()
+
+
+def test_echo_socket_variant():
+    """`communication: {local: uds}` in the YAML routes node<->daemon
+    traffic over Unix domain sockets (reference
+    examples/rust-dataflow/dataflow_socket.yml)."""
+    from dora_tpu.daemon import run_dataflow
+
+    df = REPO / "examples" / "echo" / "dataflow_socket.yml"
+    result = run_dataflow(df, timeout_s=120)
+    assert result.is_ok(), result.errors()
+
+
+def test_echo_dynamic_variant():
+    """`path: dynamic` receiver attached from an external process
+    (reference examples/rust-dataflow/dataflow_dynamic.yml)."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "echo" / "run_dynamic.py")],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "dynamic dataflow finished successfully" in proc.stdout

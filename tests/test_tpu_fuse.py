@@ -348,3 +348,88 @@ def test_pipeline_depth_env(monkeypatch):
     monkeypatch.delenv("DORA_PIPELINE_DEPTH")
     # CPU backend default: synchronous
     assert fuse.pipeline_depth_from_env() == 0
+
+
+def test_fetch_ring_correctness_and_flush(tmp_path):
+    """fetch_every=4: outputs still arrive complete, in tick order, with
+    state threaded — and a partial group flushes on harvest(block) (and
+    on the linger timer for sporadic streams)."""
+    descriptor = pipeline_descriptor(tmp_path)
+    graph = FusedGraph.build(descriptor.node("pipeline"), descriptor)
+    executor = FusedExecutor(graph, pipeline_depth=2, fetch_every=4)
+
+    results = []
+    for i in range(6):  # one full group of 4 + a partial group of 2
+        executor.on_event_async("double/x", pa.array([float(i)]), {})
+        results.extend(executor.harvest())
+    results.extend(executor.harvest(block=True))
+    assert len(results) == 6
+    values = [out["plus/y"][0].to_numpy()[0] for out in results]
+    np.testing.assert_allclose(values, [2 * i + 1 for i in range(6)])
+    assert int(np.asarray(executor.states["plus"])) == 6
+    executor.close()
+
+
+def test_fetch_ring_linger_timer_flushes_partial_group(tmp_path):
+    import time
+
+    descriptor = pipeline_descriptor(tmp_path)
+    graph = FusedGraph.build(descriptor.node("pipeline"), descriptor)
+    executor = FusedExecutor(graph, pipeline_depth=2, fetch_every=8)
+    executor._linger_s = 0.05
+    executor.on_event_async("double/x", pa.array([3.0]), {})
+    assert executor.harvest() == []  # staged, not yet fetched
+    deadline = time.monotonic() + 5
+    results = []
+    while not results and time.monotonic() < deadline:
+        time.sleep(0.01)
+        results = executor.harvest()
+    assert len(results) == 1
+    np.testing.assert_allclose(results[0]["plus/y"][0].to_numpy(), [7.0])
+    executor.close()
+
+
+def test_fetch_ring_amortizes_injected_latency(tmp_path, monkeypatch):
+    """The VERDICT-r4 weakness: FPS was hostage to per-frame fetch RTT.
+    Inject +60 ms per fetch: the grouped ring (fetch_every=8) must push
+    N frames per round trip, beating per-tick fetching by the group
+    factor (within scheduling noise) — steady throughput decoupled from
+    the latency term."""
+    import time
+
+    from dora_tpu.tpu import fuse
+
+    real = fuse._fetch
+
+    def slow_fetch(value):
+        time.sleep(0.06)
+        return real(value)
+
+    monkeypatch.setattr(fuse, "_fetch", slow_fetch)
+    descriptor = pipeline_descriptor(tmp_path)
+    graph = FusedGraph.build(descriptor.node("pipeline"), descriptor)
+
+    def run(fetch_every: int, ticks: int = 24) -> float:
+        executor = FusedExecutor(
+            graph, pipeline_depth=2, fetch_every=fetch_every
+        )
+        n = 0
+        t0 = time.perf_counter()
+        for i in range(ticks):
+            executor.on_event_async("double/x", pa.array([float(i)]), {})
+            n += len(executor.harvest())
+        n += len(executor.harvest(block=True))
+        dt = time.perf_counter() - t0
+        assert n == ticks
+        executor.close()
+        return dt
+
+    run(8, ticks=4)  # warm the jit/XLA cache out of the timed runs
+    grouped = run(8)
+    per_tick = run(1)
+    # per-tick: 24 fetches / 3 pool workers ≥ 8 serial RTTs ≈ 0.48 s.
+    # grouped: 3 group fetches (≈ 0.2 s even fully serialized by the
+    # in-flight-ticks backpressure bound). Margin is loose (0.65) —
+    # under full-suite load scheduling noise inflates both runs.
+    assert per_tick > 0.4, per_tick
+    assert grouped < per_tick * 0.65, (grouped, per_tick)

@@ -1,0 +1,120 @@
+"""Round-5 speculation decomposition: where do the worst-case 12% go?
+
+Times, with the amortized-chain methodology (fetch-synced, RTT
+subtracted): the vanilla fused step at unroll 4 and 1, the M=5 fused
+chunk pass, the M=5 UNFUSED chunk verify, and (with the ``gen`` arg)
+the full speculation loop at worst case with same-run vanilla.
+"""
+import os, sys, time
+os.environ.setdefault("DORA_INT8_DECODE", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from bench_vlm import _tunnel_rtt_s, _amortized_s
+from dora_tpu.models import vlm
+from dora_tpu.models import layers as L
+
+cfg = vlm.VLMConfig.bench_2b()
+rtt = _tunnel_rtt_s()
+print(f"# rtt {rtt*1e3:.1f} ms", flush=True)
+t0 = time.time()
+params = vlm.init_params(jax.random.PRNGKey(0), cfg)
+params = jax.jit(lambda p: vlm.quantize_decode(p), donate_argnums=0)(params)
+jax.block_until_ready(jax.tree.leaves(params)[0])
+print(f"# params {time.time()-t0:.1f}s", flush=True)
+
+STEPS = 32
+POS = 300
+
+
+def time_scan(step_fn, label, unroll=1, width=1):
+    # Thread the emitted token(s) back into the next step so NOTHING is
+    # dead code (a discarded lm_head output is eliminated by XLA and the
+    # timing lies by ~10x).
+    caches = vlm.init_cache(cfg, 1)
+    tok0 = jnp.full((width,), 5, jnp.int32)
+
+    @jax.jit
+    def chain(params, caches, tok0):
+        def body(carry, _):
+            t, c, p = carry
+            out, c = step_fn(params, t, c, p)
+            return (out % cfg.vocab, c, p + 1), None
+        (t, c, p), _ = jax.lax.scan(
+            body, (tok0, caches, jnp.asarray(POS, jnp.int32)), None,
+            length=STEPS, unroll=unroll,
+        )
+        return t[0].astype(jnp.float32)
+
+    s = _amortized_s(lambda: chain(params, caches, tok0), STEPS, rtt)
+    print(f"{label}: {s*1e3:.3f} ms/iter", flush=True)
+    return s
+
+
+def single(params, t, c, p):
+    return vlm.decode_step_fused(params, cfg, t, c, p)
+
+
+def chunk5(params, t, c, p):
+    return vlm.decode_chunk_fused(params, cfg, t[None], c, p)
+
+
+def chunk5_unfused(params, t, c, p):
+    chunk = t[None]
+    dtype = L.compute_dtype()
+    chunk_pos = p + jnp.arange(5)
+    mask = (
+        jnp.arange(cfg.max_seq)[None, None, None, :]
+        <= chunk_pos[None, None, :, None]
+    )
+    h = params["embed"].astype(dtype)[chunk]
+    h, new_caches = vlm._lm_forward(
+        params, cfg, h, chunk_pos[None], mask, caches=c, cache_index=p
+    )
+    greedy = jnp.argmax(
+        L.matmul(h[0], params["lm_head"]).astype(jnp.float32), axis=-1
+    ).astype(jnp.int32)
+    return greedy, new_caches
+
+
+a4 = time_scan(single, "single fused step, unroll=4", unroll=4)
+a1 = time_scan(single, "single fused step, unroll=1", unroll=1)
+c5 = time_scan(chunk5, "fused chunk-5 pass, unroll=1", width=5)
+u5 = time_scan(chunk5_unfused, "UNFUSED chunk-5 pass, unroll=1", width=5)
+print(f"# chunk5/single4 = {c5/a4:.3f}  chunk5/single1 = {c5/a1:.3f}",
+      flush=True)
+
+if "gen" not in sys.argv:
+    sys.exit(0)
+
+image = jax.random.uniform(
+    jax.random.PRNGKey(1), (1, cfg.image_size, cfg.image_size, 3)
+)
+rep = jnp.asarray([[11, 12, 13, 14] * 8], jnp.int32)
+MAXNEW = 64
+
+
+def run_gen(fn, label):
+    t = fn()
+    int(t[0, -1])  # sync after compile
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn()
+        int(out[0, -1])
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    tokps = MAXNEW / max(best - rtt, 1e-9)
+    print(f"{label}: {tokps:.1f} tok/s", flush=True)
+    return tokps
+
+
+van = run_gen(
+    lambda: vlm.generate(params, cfg, image, rep, MAXNEW), "vanilla fused"
+)
+os.environ["DORA_SPEC_WORST_CASE"] = "1"
+wc = run_gen(
+    lambda: vlm.generate_speculative(params, cfg, image, rep, MAXNEW)[0],
+    "spec worst-case",
+)
+del os.environ["DORA_SPEC_WORST_CASE"]
+print(f"# worst-case ratio {wc/van:.3f}", flush=True)
