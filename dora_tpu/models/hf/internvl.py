@@ -455,6 +455,7 @@ def _generate_spec_jit(params, cfg: InternVLConfig, input_ids, image_feats,
         caches=caches, history=history, hist_len=t + 1, first=first[0],
         max_new_tokens=max_new_tokens, seq=tc.max_seq, verify=verify,
         k=k, ngram=ngram,
+        body=spec_decode.fitting_body_passes(t, max_new_tokens, tc.max_seq, k),
     )
 
 
@@ -558,9 +559,9 @@ def make_serving_step(cfg: InternVLConfig, prompt_ids: np.ndarray,
     geometry — the TPU operator-tier shape (one XLA program per tick).
     ``speculative`` routes decode through prompt-lookup speculation
     (identical greedy tokens; needs k+1=5 tokens of max_seq headroom)."""
-    from dora_tpu.models.spec_decode import SPEC_HEADROOM
+    from dora_tpu.models.spec_decode import spec_headroom
 
-    headroom = SPEC_HEADROOM if speculative else 0
+    headroom = spec_headroom() if speculative else 0
     if prompt_ids.shape[1] + max_new_tokens + headroom > cfg.text.max_seq:
         raise ValueError("prompt + max_new_tokens exceeds max_seq")
     prompt = jnp.asarray(prompt_ids, jnp.int32)

@@ -497,6 +497,9 @@ def _translate_spec_jit(params, cfg: MarianConfig, src_ids, src_mask,
         caches=caches, history=history, hist_len=2, first=first[0],
         max_new_tokens=max_new_tokens, seq=cfg.max_tokens, verify=verify,
         k=k, ngram=ngram,
+        body=spec_decode.fitting_body_passes(
+            1, max_new_tokens, cfg.max_tokens, k
+        ),
     )
     if cfg.forced_eos_token is not None:
         # transformers replaces the final emission at max length; the

@@ -604,6 +604,9 @@ def _generate_spec_jit(params, cfg: Qwen2VLConfig, input_ids, image_feats,
         caches=caches, history=history, hist_len=t + 1, first=first[0],
         max_new_tokens=max_new_tokens, seq=cfg.max_seq, verify=verify,
         k=k, ngram=ngram,
+        body=spec_decode.fitting_body_passes(
+            t, max_new_tokens, cfg.max_seq, k
+        ),
     )
 
 
@@ -679,9 +682,9 @@ def make_serving_step(cfg: Qwen2VLConfig, prompt_ids: np.ndarray,
     cos = jnp.asarray(np.cos(freqs))
     sin = jnp.asarray(np.sin(freqs))
     position_ids, deltas = rope_index(cfg, prompt_ids, grid_thw)
-    from dora_tpu.models.spec_decode import SPEC_HEADROOM
+    from dora_tpu.models.spec_decode import spec_headroom
 
-    headroom = SPEC_HEADROOM if speculative else 0
+    headroom = spec_headroom() if speculative else 0
     if prompt_ids.shape[1] + max_new_tokens + headroom > cfg.max_seq:
         raise ValueError("prompt + max_new_tokens exceeds max_seq")
     prompt = jnp.asarray(prompt_ids, jnp.int32)

@@ -463,6 +463,7 @@ def _transcribe_spec_jit(params, cfg: WhisperConfig, input_features,
         caches=caches, history=history, hist_len=f + 1, first=first[0],
         max_new_tokens=max_new, seq=cfg.max_target, verify=verify,
         k=k, ngram=ngram,
+        body=spec_decode.fitting_body_passes(f, max_new, cfg.max_target, k),
     )
 
 

@@ -14,9 +14,15 @@ static-shape: verification writes positions p..p+k, and rejected tail
 entries are provably overwritten before they become attendable (the
 next chunk starts at the first rejected position).
 
-Serving gates use ``SPEC_HEADROOM``: the history/out buffers and the
-cache need k+1 tokens of max_seq slack so the loop can never hit the
-context limit with tokens still owed (which would break exactness).
+Serving gates reserve ``spec_headroom()`` (k+1) tokens of max_seq
+slack — the minimum one verification pass writes — so the loop can
+never hit the context limit with tokens still owed (which would break
+exactness). Round 5: ``DORA_SPEC_BODY`` fuses N passes per while body
+(the while-loop equivalent of the decode scan's unroll), which can
+overshoot by up to N-1 discarded passes after max_new; the out/history
+buffers carry N*(k+1) of slack and callers pick the largest N whose
+overshoot still fits max_seq (``fitting_body_passes``) — the k+1 gate
+stays sufficient because N degrades to 1 in tight contexts.
 """
 
 from __future__ import annotations
@@ -27,7 +33,41 @@ import jax.numpy as jnp
 #: Default draft length / lookup ngram; headroom every gate must check.
 SPEC_K = 4
 SPEC_NGRAM = 2
-SPEC_HEADROOM = SPEC_K + 1
+SPEC_HEADROOM = SPEC_K + 1  # single-pass slack; gates use spec_headroom()
+
+
+def body_passes() -> int:
+    """Speculation passes fused into one while_loop body (DORA_SPEC_BODY,
+    default 4). Round-5 profiling (tools_r5/spec_profile.py) showed the
+    whole worst-case floor gap is the while_loop losing the decode
+    scan's unroll amortization: a fused chunk-5 pass costs the SAME as
+    one un-unrolled single step (0.99x), while unroll=4 makes single
+    steps ~15% cheaper per token. Running N passes back to back inside
+    one body removes N-1 loop boundaries per body — the while-loop
+    equivalent of unroll. Cost: the loop can overshoot by up to N-1
+    passes after max_new is reached (discarded tokens, headroom slack
+    grows to N*(k+1))."""
+    import os
+
+    return max(1, int(os.environ.get("DORA_SPEC_BODY", "4")))
+
+
+def spec_headroom(k: int = SPEC_K) -> int:
+    """MINIMUM max_seq slack speculation needs (one pass of k+1 cache
+    rows). The body factor degrades to fit (fitting_body_passes), so
+    gates reserve only this — identical to the round-4 contract."""
+    return k + 1
+
+
+def fitting_body_passes(context_len: int, max_new_tokens: int,
+                        max_seq: int, k: int = SPEC_K) -> int:
+    """Largest body factor (≤ DORA_SPEC_BODY) whose overshoot slack
+    still fits max_seq — tight-context configs degrade toward body=1
+    (round-4 behavior) instead of refusing to speculate."""
+    ppb = body_passes()
+    while ppb > 1 and context_len + max_new_tokens + ppb * (k + 1) > max_seq:
+        ppb //= 2
+    return max(1, ppb)
 
 #: Adaptive gating (round 4): speculation must never lose. A k+1-token
 #: verification pass is ~15% dearer than a single decode step (extra
@@ -70,7 +110,8 @@ def lookup(history, hist_len, seq: int, k: int, ngram: int):
 
 def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
              seq: int, verify, k: int = SPEC_K, ngram: int = SPEC_NGRAM,
-             adaptive: bool | None = None, return_stats: bool = False):
+             adaptive: bool | None = None, return_stats: bool = False,
+             body: int | None = None):
     """The speculation while_loop (call inside a jit).
 
     ``history`` is a [seq] int32 buffer holding the known token ids
@@ -100,7 +141,8 @@ def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
         # the mechanism that actually bounds the worst case
         # (BENCHMARKS.md round-4 speculation matrix).
         adaptive = os.environ.get("DORA_SPEC_ADAPTIVE", "0") not in ("", "0")
-    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
+    ppb = body_passes() if body is None else max(1, body)
+    out = jnp.zeros((max_new_tokens + ppb * (k + 1),), jnp.int32)
     out = out.at[0].set(first)
 
     def commit(carry, greedy, emitted, width, ema, spec_inc):
@@ -116,9 +158,14 @@ def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
             ),
             (hist_len,),
         )
+        # Body-fused loops overshoot by up to body-1 passes after
+        # max_new is reached; those passes' outputs are discarded, so
+        # the stats only count passes that still owed tokens.
+        useful = (n_emitted < max_new_tokens).astype(jnp.int32)
         return (
             caches_, history, hist_len + emitted, out,
-            n_emitted + emitted, passes + 1, ema, spec_passes + spec_inc,
+            n_emitted + emitted, passes + useful, ema,
+            spec_passes + spec_inc * useful,
         )
 
     def spec_pass(carry):
@@ -153,12 +200,22 @@ def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
                       jnp.asarray(0, jnp.int32))
 
     if adaptive:
-        def body(carry):
+        def one_pass(carry):
             return jax.lax.cond(
                 carry[6] >= ADAPT_THRESHOLD, spec_pass, plain_pass, carry
             )
     else:
-        body = spec_pass
+        one_pass = spec_pass
+
+    def body(carry):
+        # N passes back to back per while iteration (see body_passes):
+        # XLA overlaps the tail of pass i with the head of pass i+1 the
+        # same way the vanilla decode scan's unroll does — without this,
+        # each pass pays ~15% un-amortized step overhead and the
+        # worst-case floor sits at ~0.86x instead of >=0.95x.
+        for _ in range(ppb):
+            carry = one_pass(carry)
+        return carry
 
     def cond(carry):
         return carry[4] < max_new_tokens
@@ -176,18 +233,20 @@ def run_loop(*, caches, history, hist_len, first, max_new_tokens: int,
 def check_headroom(context_len: int, max_new_tokens: int, max_seq: int,
                    what: str, k: int = SPEC_K) -> None:
     """Trace-time exactness guard shared by every entry point."""
-    total = context_len + max_new_tokens + k + 1
+    headroom = spec_headroom(k)
+    total = context_len + max_new_tokens + headroom
     if total > max_seq:
         raise ValueError(
             f"{what} ({context_len}) + max_new_tokens ({max_new_tokens}) "
-            f"+ speculation headroom ({k + 1}) exceeds max_seq ({max_seq})"
+            f"+ speculation headroom ({headroom}) exceeds max_seq "
+            f"({max_seq})"
         )
 
 
 def fits(context_len: int, max_new_tokens: int, max_seq: int,
          k: int = SPEC_K) -> bool:
     """Gate helper for serving paths that degrade instead of raising."""
-    return context_len + max_new_tokens + k + 1 <= max_seq
+    return context_len + max_new_tokens + spec_headroom(k) <= max_seq
 
 
 def gate_speculation(context_len: int, max_new_tokens: int, max_seq: int,
@@ -206,6 +265,6 @@ def gate_speculation(context_len: int, max_new_tokens: int, max_seq: int,
     logging.getLogger(__name__).warning(
         "DORA_SPEC_DECODE disabled: needs batch-1 and %d tokens of "
         "context within max_seq (%d); serving vanilla greedy",
-        context_len + max_new_tokens + SPEC_HEADROOM, max_seq,
+        context_len + max_new_tokens + spec_headroom(), max_seq,
     )
     return False

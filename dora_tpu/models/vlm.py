@@ -616,6 +616,9 @@ def _generate_spec_jit(params, cfg: VLMConfig, images, prompt_ids,
         caches=caches, history=history, hist_len=t_prompt + 1,
         first=first[0], max_new_tokens=max_new_tokens, seq=seq,
         verify=verify, k=k, ngram=ngram,
+        body=spec_decode.fitting_body_passes(
+            cfg.n_patches + t_prompt, max_new_tokens, seq, k
+        ),
     )
 
 

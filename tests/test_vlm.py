@@ -246,3 +246,19 @@ def test_spec_non_adaptive_always_chunks():
     np.testing.assert_array_equal(tokens, expected[:max_new])
     # `passes` starts at 1 (the prefill argmax); every loop pass chunks
     assert spec_passes == passes - 1
+
+
+def test_spec_body_passes_identical_output(monkeypatch):
+    """DORA_SPEC_BODY (N passes fused per while body — the while-loop
+    equivalent of the decode scan's unroll) must not change emitted
+    tokens, only the loop-boundary count."""
+    max_new = 40
+    expected = [(7 * j + 3) % 251 for j in range(max_new + 30)]
+    outs = {}
+    for body in ("1", "4"):
+        monkeypatch.setenv("DORA_SPEC_BODY", body)
+        tokens, passes, _ = _synthetic_loop(expected, max_new,
+                                            adaptive=False)
+        outs[body] = tokens
+    np.testing.assert_array_equal(outs["1"], outs["4"])
+    np.testing.assert_array_equal(outs["1"], expected[:max_new])
