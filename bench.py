@@ -197,10 +197,13 @@ def serving_fps() -> dict:
     env = dict(os.environ)
     env.setdefault("DORA_INT8_DECODE", "1")
     env.setdefault("DORA_PIPELINE_DEPTH", "8")
-    # Round 5: device-side output ring — 8 frames share one
-    # device→host fetch, decoupling steady FPS from tunnel RTT
-    # (tpu/fuse.fetch_every_from_env).
-    env.setdefault("DORA_FETCH_EVERY", "8")
+    # DORA_FETCH_EVERY (the round-5 device-side output ring) is NOT
+    # defaulted here: a same-session A/B measured the ring at 22.1 FPS
+    # mean vs 25.4 without (peak window 39.9 vs 32.3) — on this tunnel
+    # the DISPATCH direction dominates, and a late group delays N
+    # frames at once, dragging the mean. The ring stays an opt-in for
+    # fetch-latency-bound deployments (see BENCHMARKS.md round-5 ring
+    # section and the injected-latency test).
     env.setdefault("BENCH_MAX_NEW", "4")
     env.setdefault("BENCH_FRAMES", "6000")
     proc = subprocess.run(
