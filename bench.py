@@ -20,9 +20,17 @@ round-3 best config (int8 decode + pipelined ticks). If no TPU is
 attached (or the serving bench fails) the primary metric still prints,
 with ``e2e_fps: null`` and the reason.
 
+Small-message axis (round 6): msgs/sec and p50/p99 latency for 1 KiB
+inline messages through a 3-node chain (src -> relay -> sink), measured
+twice — the daemon route (tcp channels, p2p off: every hop pays the
+node->daemon->node socket path, the compiled-serde + coalesced-I/O
+target) and the p2p route (shmem channels + direct node->node edges).
+
 Prints exactly ONE JSON line (the last line of stdout):
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
    "runs": N, "spread_us": [lo, hi], "baseline_us": ...,
+   "msgs_per_sec_1kib": {"daemon": ..., "p2p": ...},
+   "p50_us_1kib": {...}, "p99_us_1kib": {...},
    "e2e_fps": ..., "e2e_vs_north_star": ...}
 """
 
@@ -42,6 +50,17 @@ from pathlib import Path
 SIZE = 40 * 1024 * 1024
 ROUNDS = 30  # messages per run
 RUNS = int(os.environ.get("BENCH_LATENCY_RUNS", "5"))
+
+# Small-message leg (round 6): 1 KiB inline messages through a 3-node
+# chain — the 100 Hz-1 kHz traffic shape the 40 MB axis never sees.
+# Two phases per run: a burst (msgs/sec, receive-side window) and a
+# 500 Hz paced tail (p50/p99 latency without self-inflicted queueing —
+# a burst's latency only measures its own queue depth).
+MSG_SIZE = 1024
+MSG_COUNT = int(os.environ.get("BENCH_SMALL_MSGS", "2000"))
+LAT_COUNT = int(os.environ.get("BENCH_SMALL_LAT_MSGS", "300"))
+LAT_INTERVAL_S = 0.002
+SMALL_RUNS = int(os.environ.get("BENCH_SMALL_RUNS", "3"))
 
 
 def tcp_loopback_p50_us() -> float:
@@ -158,6 +177,185 @@ def dataflow_p50_us(workdir: Path) -> float:
     return json.loads((workdir / "latency.json").read_text())
 
 
+def small_message_run(workdir: Path, route: str) -> dict:
+    """One 1 KiB x MSG_COUNT run through src -> relay -> sink.
+
+    route "daemon": tcp node channels, p2p edges off — every message
+    pays the node->daemon->node socket path (the coalescing target).
+    route "p2p": shmem channels + direct node->node shmem edges.
+
+    Returns {"msgs_per_sec", "p50_us", "p99_us", "received"} measured at
+    the sink (receive-side window; latency is send-stamp to arrival,
+    perf_counter_ns is cross-process comparable on Linux).
+    """
+    src = workdir / "small_src.py"
+    src.write_text(textwrap.dedent(f"""
+        import time
+
+        from dora_tpu.node import Node
+
+        payload = b"x" * {MSG_SIZE}
+        node = Node()
+        for event in node:
+            if event["type"] == "INPUT":
+                break  # first tick: go
+        # Phase 0: throughput burst.
+        for _ in range({MSG_COUNT}):
+            node.send_output(
+                "out", payload, {{"t": time.perf_counter_ns(), "p": 0}}
+            )
+        # Let the chain drain the burst: latency probes must not queue
+        # behind phase-0 messages still in flight downstream.
+        time.sleep(3.0)
+        # Phase 1: paced latency probes (below capacity, so each sample
+        # measures the transport, not the probe's own queueing).
+        for _ in range({LAT_COUNT}):
+            time.sleep({LAT_INTERVAL_S})
+            node.send_output(
+                "out", payload, {{"t": time.perf_counter_ns(), "p": 1}}
+            )
+        node.close()
+    """))
+    relay = workdir / "small_relay.py"
+    relay.write_text(textwrap.dedent("""
+        from dora_tpu.node import Node
+
+        node = Node()
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            node.send_output("out", bytes(event["value"]), event["metadata"])
+        node.close()
+    """))
+    sink = workdir / "small_sink.py"
+    sink.write_text(textwrap.dedent("""
+        import json
+        import statistics
+        import time
+
+        from dora_tpu.node import Node
+
+        tput_times = []  # phase-0 arrival stamps (throughput window)
+        lat = []         # phase-1 per-message latencies, us
+        node = Node()
+        for event in node:
+            if event["type"] != "INPUT":
+                continue
+            now = time.perf_counter_ns()
+            meta = event["metadata"]
+            if meta.get("p") == 0:
+                tput_times.append(now)
+            else:
+                lat.append((now - meta["t"]) / 1e3)
+        node.close()
+        lat.sort()
+        elapsed_s = (
+            (tput_times[-1] - tput_times[0]) / 1e9
+            if len(tput_times) > 1 else float("inf")
+        )
+        result = {
+            "received": len(tput_times) + len(lat),
+            "msgs_per_sec": (
+                (len(tput_times) - 1) / elapsed_s
+                if len(tput_times) > 1 else 0.0
+            ),
+            "p50_us": statistics.median(lat) if lat else None,
+            "p99_us": (
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else None
+            ),
+        }
+        open("small_msg.json", "w").write(json.dumps(result))
+    """))
+    # queue_size >= MSG_COUNT: throughput, not the drop-oldest contract,
+    # is under test — nothing may be shed mid-run.
+    spec = {
+        "nodes": [
+            {
+                "id": "small-src",
+                "path": "small_src.py",
+                "inputs": {"tick": "dora/timer/millis/100"},
+                "outputs": ["out"],
+            },
+            {
+                "id": "small-relay",
+                "path": "small_relay.py",
+                "inputs": {
+                    "data": {
+                        "source": "small-src/out",
+                        "queue_size": MSG_COUNT + LAT_COUNT,
+                    }
+                },
+                "outputs": ["out"],
+            },
+            {
+                "id": "small-sink",
+                "path": "small_sink.py",
+                "inputs": {
+                    "data": {
+                        "source": "small-relay/out",
+                        "queue_size": MSG_COUNT + LAT_COUNT,
+                    }
+                },
+            },
+        ],
+        # The YAML block picks the node-channel transport on old AND new
+        # code (both honor it when no explicit local_comm is passed).
+        "communication": {"local": "tcp" if route == "daemon" else "shmem"},
+    }
+    import yaml
+
+    df = workdir / "small.yml"
+    df.write_text(yaml.safe_dump(spec))
+
+    from dora_tpu.daemon import run_dataflow
+
+    overrides = {
+        # Old code ignores DORA_SEND_COALESCE (harmless): the A/B then
+        # measures exactly the code change, same knobs both sides.
+        "DORA_P2P": "0" if route == "daemon" else "1",
+        "DORA_SEND_COALESCE": os.environ.get("DORA_SEND_COALESCE", "8192"),
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        result = run_dataflow(df, timeout_s=180)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not result.is_ok():
+        raise RuntimeError(f"small-message dataflow failed: {result.errors()}")
+    data = json.loads((workdir / "small_msg.json").read_text())
+    expected = MSG_COUNT + LAT_COUNT
+    if data["received"] < expected:
+        data["note"] = f"only {data['received']}/{expected} delivered"
+    return data
+
+
+def small_message_leg(route: str) -> dict:
+    """Median-of-SMALL_RUNS small-message numbers for one route."""
+    runs = []
+    for i in range(SMALL_RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-small-") as tmp:
+            runs.append(small_message_run(Path(tmp), route))
+        print(
+            f"# small {route} run {i + 1}/{SMALL_RUNS}: "
+            f"{runs[-1]['msgs_per_sec']:.0f} msg/s, "
+            f"p50 {runs[-1]['p50_us']:.0f} us",
+            file=sys.stderr,
+        )
+    rates = sorted(r["msgs_per_sec"] for r in runs)
+    return {
+        "msgs_per_sec": round(statistics.median(rates), 0),
+        "msgs_per_sec_spread": [round(rates[0], 0), round(rates[-1], 0)],
+        "p50_us": round(statistics.median(r["p50_us"] for r in runs), 1),
+        "p99_us": round(statistics.median(r["p99_us"] for r in runs), 1),
+        "received": min(r["received"] for r in runs),
+    }
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -247,16 +445,39 @@ def main() -> int:
 
     # Interleave dataflow runs and baseline runs so both see the same
     # machine conditions; medians of each side make the ratio robust.
+    # A failing run reports as nulls + note (same contract as the other
+    # legs): environments without working native shmem must still emit
+    # the small-message and serving axes.
     ours_runs: list[float] = []
     base_runs: list[float] = []
+    headline_note = None
     for i in range(RUNS):
-        with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-") as tmp:
-            ours_runs.append(dataflow_p50_us(Path(tmp)))
+        try:
+            with tempfile.TemporaryDirectory(prefix="dora-tpu-bench-") as tmp:
+                ours_runs.append(dataflow_p50_us(Path(tmp)))
+        except Exception as exc:
+            headline_note = f"40MB leg failed: {exc!r}"[:200]
+            print(f"# run {i + 1}/{RUNS}: {headline_note}", file=sys.stderr)
+            break
         base_runs.append(tcp_loopback_p50_us())
         print(f"# run {i + 1}/{RUNS}: ours {ours_runs[-1]:.1f} us, "
               f"baseline {base_runs[-1]:.1f} us", file=sys.stderr)
-    ours = statistics.median(ours_runs)
-    baseline = statistics.median(base_runs)
+    ours = statistics.median(ours_runs) if ours_runs else None
+    baseline = statistics.median(base_runs) if base_runs else None
+
+    # Small-message axis: both routes; a failure reports as nulls + note
+    # rather than sinking the headline metric.
+    small: dict = {}
+    for route in ("daemon", "p2p"):
+        try:
+            small[route] = small_message_leg(route)
+        except Exception as exc:
+            small[route] = {
+                "msgs_per_sec": None,
+                "p50_us": None,
+                "p99_us": None,
+                "note": f"failed: {exc!r}"[:200],
+            }
 
     try:
         e2e = serving_fps()
@@ -265,15 +486,29 @@ def main() -> int:
 
     record = {
         "metric": "40MB inter-node message p50 latency",
-        "value": round(ours, 1),
+        "value": None if ours is None else round(ours, 1),
         "unit": "us",
-        "vs_baseline": round(baseline / ours, 2),
+        "vs_baseline": (
+            None if ours is None or baseline is None
+            else round(baseline / ours, 2)
+        ),
         "runs": RUNS,
-        "spread_us": [round(min(ours_runs), 1), round(max(ours_runs), 1)],
-        "baseline_us": round(baseline, 1),
-        "baseline_spread_us": [
-            round(min(base_runs), 1), round(max(base_runs), 1)
-        ],
+        "spread_us": (
+            None if not ours_runs
+            else [round(min(ours_runs), 1), round(max(ours_runs), 1)]
+        ),
+        "baseline_us": None if baseline is None else round(baseline, 1),
+        "baseline_spread_us": (
+            None if not base_runs
+            else [round(min(base_runs), 1), round(max(base_runs), 1)]
+        ),
+        "headline_note": headline_note,
+        "msgs_per_sec_1kib": {
+            route: small[route]["msgs_per_sec"] for route in small
+        },
+        "p50_us_1kib": {route: small[route]["p50_us"] for route in small},
+        "p99_us_1kib": {route: small[route]["p99_us"] for route in small},
+        "small_msg_detail": small,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

@@ -395,7 +395,7 @@ def cmd_daemon(args) -> int:
 
         return asyncio.run(standalone())
 
-    daemon = Daemon(local_comm=args.local_comm)
+    daemon = Daemon(local_comm=args.local_comm or "tcp")
     asyncio.run(daemon.run(args.coordinator_addr, args.machine_id))
     return 0
 
@@ -508,7 +508,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--machine-id", default="")
     p.add_argument("--run-dataflow", default=None, metavar="DATAFLOW_YAML",
                    help="standalone mode: run one dataflow and exit")
-    p.add_argument("--local-comm", default="tcp", choices=["tcp", "uds", "shmem"])
+    p.add_argument("--local-comm", default=None, choices=["tcp", "uds", "shmem"],
+                   help="node channel transport; default: the dataflow "
+                        "YAML's communication.local, else tcp")
     p.set_defaults(fn=cmd_daemon)
 
     p = sub.add_parser("runtime", help="run the operator runtime (internal)")

@@ -20,6 +20,7 @@ from dora_tpu.transport.framing import (
     ConnectionClosed,
     recv_frame_async,
     send_frame_async,
+    send_frames_async,
 )
 
 
@@ -31,6 +32,12 @@ class NodeConnection:
 
     async def send(self, payload: bytes) -> None:
         raise NotImplementedError
+
+    async def send_many(self, payloads: list[bytes]) -> None:
+        """Coalesced send: deliver every frame, amortizing the per-send
+        cost where the transport allows (vectored write on streams)."""
+        for payload in payloads:
+            await self.send(payload)
 
     def close(self) -> None:
         raise NotImplementedError
@@ -51,6 +58,9 @@ class StreamConnection(NodeConnection):
 
     async def send(self, payload: bytes) -> None:
         await send_frame_async(self.writer, payload)
+
+    async def send_many(self, payloads: list[bytes]) -> None:
+        await send_frames_async(self.writer, payloads)
 
     def close(self) -> None:
         try:

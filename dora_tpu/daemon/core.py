@@ -48,9 +48,11 @@ from dora_tpu.message.common import (
     TypeInfo,
     ENCODING_RAW,
 )
+from dora_tpu.message import fastroute
 from dora_tpu.message.serde import (
     Timestamped,
     decode_timestamped,
+    encode,
     encode_timestamped,
 )
 from dora_tpu.native import ShmemChannel, ShmemRegion
@@ -104,6 +106,11 @@ class DataflowState:
     tokens: dict[str, TokenState] = field(default_factory=dict)
     #: per-receiver tokens delivered in a NextEvents batch but not yet acked
     delivered_tokens: dict[str, set[str]] = field(default_factory=dict)
+    #: (sender, output_id) -> (OutputId, [(receiver node, input id)]) —
+    #: the wire fast path's view of ``mappings`` with the id parsing and
+    #: stringification done once (mappings are fixed after spawn; the
+    #: mutable open_outputs/open_inputs/p2p_edges are re-checked per send)
+    route_cache: dict[tuple[str, str], Any] = field(default_factory=dict)
     running_nodes: dict[str, RunningNode] = field(default_factory=dict)
     node_results: dict[str, NodeResult] = field(default_factory=dict)
     stderr_rings: dict[str, list[str]] = field(default_factory=dict)
@@ -548,6 +555,45 @@ class Daemon:
             del df.tokens[token]
             self._notify_owner(df, sender, token)
 
+    def send_out_wire(
+        self, df: DataflowState, sender: str, fast: "fastroute.FastSend"
+    ) -> bool:
+        """Route a shallow-parsed inline SendMessage by splicing wire
+        bytes — no metadata/data object trees, no re-encode on delivery.
+
+        Returns False (nothing pushed) when any receiver is remote: the
+        inter-daemon path needs the decoded metadata, so the caller
+        falls back to the reflective route for the whole frame.
+        """
+        key = (sender, fast.output_id)
+        cached = df.route_cache.get(key)
+        if cached is None:
+            oid = OutputId(NodeId(sender), DataId(fast.output_id))
+            cached = (
+                oid,
+                [(str(t.node), str(t.input)) for t in df.mappings.get(oid, ())],
+            )
+            df.route_cache[key] = cached
+        oid, receivers = cached
+        if oid not in df.open_outputs:
+            return True  # dropped, like send_out on a closed output
+        if any(rnode not in df.local_nodes for rnode, _ in receivers):
+            return False
+        for rnode, input_id in receivers:
+            if (sender, fast.output_id, rnode, input_id) in df.p2p_edges:
+                continue  # the sender published this edge peer-to-peer
+            queue = df.queues.get(rnode)
+            if queue is None or input_id not in df.open_inputs.get(rnode, set()):
+                continue
+            queue.push(
+                None,
+                input_id=input_id,
+                wire=fastroute.build_input_event(
+                    input_id, fast.body, self.clock.new_timestamp()
+                ),
+            )
+        return True
+
     def deliver_remote_output(
         self, df: DataflowState, output_id: str, metadata: Metadata, payload: bytes | None
     ) -> None:
@@ -956,6 +1002,19 @@ class Daemon:
             frame = await conn.recv()
             if frame is None:
                 return
+            # Hot path: inline-payload SendMessage frames route as wire
+            # bytes (message/fastroute.py) — the metadata/data subtrees
+            # are never built as objects. Anything the fast path cannot
+            # prove routable takes the reflective decode below.
+            fast = fastroute.parse_send_message(frame)
+            if fast is not None:
+                # Clock first: the routed events' fresh timestamps must
+                # be causally after the sender's.
+                self.clock.update_with_timestamp(fast.timestamp)
+                if self.send_out_wire(df, node_id, fast):
+                    continue
+                # Remote receivers: re-decode below (the second clock
+                # update is harmless — HLC updates are monotone).
             msg = decode_timestamped(frame, self.clock).inner
             if isinstance(msg, n2d.SendMessage):
                 self.send_out(df, node_id, msg.output_id, msg.metadata, msg.data)
@@ -1013,11 +1072,21 @@ class Daemon:
             if isinstance(msg, n2d.NextEvent):
                 self.ack_tokens(df, node_id, msg.drop_tokens)
                 batch = await queue.next_batch()
-                for event in batch:
-                    token = _event_token(event)
-                    if token is not None:
-                        delivered.add(token)
-                await self._reply(conn, d2n.NextEvents(events=batch))
+                wires = []
+                for entry in batch:
+                    if entry.drop_token is not None:
+                        delivered.add(entry.drop_token)
+                    # Fast-path entries carry their wire image; others
+                    # (timers, close events, shmem inputs) encode here.
+                    wires.append(
+                        entry.wire if entry.wire is not None
+                        else encode(entry.event)
+                    )
+                await conn.send(
+                    fastroute.build_next_events_frame(
+                        wires, self.clock.new_timestamp()
+                    )
+                )
             elif isinstance(msg, n2d.EventStreamDropped):
                 queue.release_all_tokens()
                 queue.close()
@@ -1098,13 +1167,6 @@ class Daemon:
         return d2n.NodeConfigReply(node_config=self._make_node_config(df, node_id))
 
 
-def _event_token(event: Timestamped) -> str | None:
-    inner = event.inner
-    if isinstance(inner, d2n.Input) and isinstance(inner.data, SharedMemoryData):
-        return inner.data.drop_token
-    return None
-
-
 # ---------------------------------------------------------------------------
 # standalone mode (reference: daemon/src/lib.rs:157-224)
 # ---------------------------------------------------------------------------
@@ -1113,14 +1175,16 @@ def _event_token(event: Timestamped) -> str | None:
 async def run_dataflow_async(
     dataflow: str | Path | Descriptor,
     working_dir: str | Path | None = None,
-    local_comm: str = "tcp",
+    local_comm: str | None = None,
     timeout_s: float | None = None,
 ) -> DataflowResult:
-    """Run one dataflow to completion with an in-process daemon. A
-    ``communication: {local: uds|shmem|tcp}`` block in the YAML (or the
-    reference's ``_unstable_local`` spelling) overrides the default
-    ``local_comm`` — the dataflow_socket.yml idiom
-    (reference examples/rust-dataflow/dataflow_socket.yml)."""
+    """Run one dataflow to completion with an in-process daemon.
+
+    ``local_comm=None`` (default) means "use the YAML's
+    ``communication: {local: uds|shmem|tcp}`` block (or the reference's
+    ``_unstable_local`` spelling), else tcp" — the dataflow_socket.yml
+    idiom (reference examples/rust-dataflow/dataflow_socket.yml). Any
+    explicit string — including ``"tcp"`` — overrides the YAML."""
     if isinstance(dataflow, Descriptor):
         descriptor = dataflow
         working_dir = Path(working_dir or Path.cwd())
@@ -1129,7 +1193,7 @@ async def run_dataflow_async(
         descriptor = Descriptor.read(path)
         working_dir = Path(working_dir or path.parent)
     descriptor.check(working_dir)
-    if local_comm == "tcp":  # explicit non-default flag wins over YAML
+    if local_comm is None:  # any explicit choice wins over YAML
         local_comm = descriptor.communication.local.kind
 
     from dora_tpu.telemetry import install_task_dump, remove_task_dump
@@ -1155,7 +1219,7 @@ async def run_dataflow_async(
 def run_dataflow(
     dataflow: str | Path | Descriptor,
     working_dir: str | Path | None = None,
-    local_comm: str = "tcp",
+    local_comm: str | None = None,
     timeout_s: float | None = None,
 ) -> DataflowResult:
     return asyncio.run(

@@ -21,9 +21,14 @@ from dora_tpu.message.serde import Timestamped
 
 @dataclass
 class QueueEntry:
-    event: Timestamped  # Timestamped[NodeEvent]
+    #: decoded event, or None for fast-path entries that only ever exist
+    #: as wire bytes (message/fastroute.py routes without object trees)
+    event: Timestamped | None
     input_id: str | None = None  # set for Input events (drop-oldest scope)
     drop_token: str | None = None
+    #: pre-encoded ``Timestamped(event)`` wire image; the events loop
+    #: splices it into the NextEvents reply instead of re-encoding
+    wire: bytes | None = None
 
 
 @dataclass
@@ -38,8 +43,8 @@ class NodeEventQueue:
     waiter: asyncio.Future | None = None
     closed: bool = False  # no more events will ever arrive
 
-    def push(self, event: Timestamped, input_id: str | None = None,
-             drop_token: str | None = None) -> None:
+    def push(self, event: Timestamped | None, input_id: str | None = None,
+             drop_token: str | None = None, wire: bytes | None = None) -> None:
         if self.closed:
             if drop_token is not None:
                 self.on_token_unref(drop_token)
@@ -50,7 +55,7 @@ class NodeEventQueue:
             if count >= bound:
                 self._drop_oldest(input_id)
             self.input_counts[input_id] = self.input_counts.get(input_id, 0) + 1
-        self.entries.append(QueueEntry(event, input_id, drop_token))
+        self.entries.append(QueueEntry(event, input_id, drop_token, wire))
         self._wake()
 
     def _drop_oldest(self, input_id: str) -> None:
@@ -76,16 +81,22 @@ class NodeEventQueue:
         self.entries.clear()
         self.input_counts.clear()
 
-    #: Events handed out per NextEvent poll. Small on purpose: an event
-    #: delivered to the node has LEFT the drop-oldest domain — draining a
-    #: whole burst in one batch would let a fast producer bypass
-    #: queue_size for a slow consumer (the node's own buffer is equally
-    #: small, see node/events.py EventStream.DEFAULT_MAX_QUEUE).
-    MAX_BATCH = 4
+    #: Events handed out per NextEvent poll — the frame-size/fairness
+    #: ceiling on coalesced delivery, NOT the staleness bound. An event
+    #: delivered to the node has left the drop-oldest domain, but the
+    #: per-input exposure is already capped at push time: the queue never
+    #: holds more than ``queue_size`` entries per input, so one batch
+    #: cannot hand out more of an input than the YAML contract allows
+    #: (a queue_size=1 camera input still yields at most 1 per poll).
+    #: Raised 4 -> 64 in round 6: at 4, a 1 KiB-message stream paid one
+    #: node<->daemon round trip per 4 events, which capped the daemon
+    #: route at a fraction of its wire capacity (see BENCHMARKS.md
+    #: small-message axis).
+    MAX_BATCH = 64
 
-    async def next_batch(self) -> list[Timestamped]:
+    async def next_batch(self) -> list[QueueEntry]:
         """Block until events are available (or the stream closes); hand
-        out up to MAX_BATCH. Empty list = stream closed."""
+        out up to MAX_BATCH entries. Empty list = stream closed."""
         while not self.entries:
             if self.closed:
                 return []
@@ -100,7 +111,7 @@ class NodeEventQueue:
             entry = self.entries.popleft()
             if entry.input_id is not None:
                 self.input_counts[entry.input_id] -= 1
-            out.append(entry.event)
+            out.append(entry)
         return out
 
     def _wake(self) -> None:

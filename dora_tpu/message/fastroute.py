@@ -1,0 +1,146 @@
+"""Wire-level fast path for the daemon's hottest route.
+
+The daemon's per-message work is dominated by serde: it fully decodes an
+incoming ``Timestamped(SendMessage)`` frame, then re-encodes the
+``metadata``/``data`` subtrees — byte-for-byte identical on the wire —
+inside a ``Timestamped(Input)`` event for every receiver, and once more
+inside the ``NextEvents`` reply batch. This module routes without ever
+building those subtrees as Python objects:
+
+* :func:`parse_send_message` shallow-parses a frame with
+  ``msgpack.Unpacker`` — it reads the envelope headers, the output id and
+  the sender timestamp, *skips* the metadata subtree, and records the
+  byte span covering the ``metadata``+``data`` fields.
+* :func:`build_input_event` splices that span into a pre-framed
+  ``Timestamped(Input)`` wire image (msgpack is context-free, so an
+  embedded value is byte-identical to a standalone one).
+* :func:`build_next_events_frame` assembles the ``NextEvents`` reply by
+  joining per-event wire images under a hand-built array header.
+
+Every function either produces bytes identical to
+``serde.encode(<the equivalent object tree>)`` — the golden-wire tests
+assert this — or returns None so the caller falls back to the reflective
+path (shared-memory payloads, remote receivers, foreign field order).
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from dora_tpu.clock import Timestamp
+
+
+def _frag(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+_MAP1 = b"\x81"
+_MAP2 = b"\x82"
+_MAP3 = b"\x83"
+_T_KEY = _frag("t")
+_F_KEY = _frag("f")
+
+#: ``{"t": "Timestamped", "f": {"inner":`` … (envelope up to the inner value)
+_ENVELOPE_PREFIX = _MAP2 + _T_KEY + _frag("Timestamped") + _F_KEY + _MAP2 + _frag("inner")
+_TIMESTAMP_KEY = _frag("timestamp")
+#: ``{"t": "Input", "f": {"id":`` … (event up to the input-id value)
+_INPUT_PREFIX = _MAP2 + _T_KEY + _frag("Input") + _F_KEY + _MAP3 + _frag("id")
+#: ``{"t": "NextEvents", "f": {"events":`` … (reply up to the event array)
+_NEXT_EVENTS_PREFIX = _MAP2 + _T_KEY + _frag("NextEvents") + _F_KEY + _MAP1 + _frag("events")
+
+
+def _timestamp_frag(ts: Timestamp) -> bytes:
+    # Matches serde._encode_timestamp: {"t": "@ts", "f": [phys, logical, id]}.
+    return _frag({"t": "@ts", "f": list(ts.to_wire())})
+
+
+def _array_header(n: int) -> bytes:
+    if n < 16:
+        return bytes((0x90 | n,))
+    if n < 1 << 16:
+        return b"\xdc" + n.to_bytes(2, "big")
+    return b"\xdd" + n.to_bytes(4, "big")
+
+
+class FastSend:
+    """A shallow-parsed ``Timestamped(SendMessage)`` frame."""
+
+    __slots__ = ("output_id", "body", "timestamp")
+
+    def __init__(self, output_id: str, body: bytes, timestamp: Timestamp):
+        self.output_id = output_id
+        #: wire bytes spanning ``"metadata": <...>, "data": <...>`` —
+        #: exactly the tail an Input event's field map needs
+        self.body = body
+        self.timestamp = timestamp
+
+
+def parse_send_message(frame) -> FastSend | None:
+    """Shallow-parse ``Timestamped(SendMessage)`` wire bytes.
+
+    Returns None — caller must take the reflective path — for any other
+    message type, a shared-memory payload (its drop token needs the full
+    bookkeeping), or any layout surprise (e.g. a foreign writer emitting
+    fields in a different order).
+    """
+    try:
+        u = msgpack.Unpacker(raw=False, strict_map_key=False)
+        u.feed(frame)
+        if u.read_map_header() != 2 or u.unpack() != "t":
+            return None
+        if u.unpack() != "Timestamped" or u.unpack() != "f":
+            return None
+        if u.read_map_header() != 2 or u.unpack() != "inner":
+            return None
+        if u.read_map_header() != 2 or u.unpack() != "t":
+            return None
+        if u.unpack() != "SendMessage" or u.unpack() != "f":
+            return None
+        if u.read_map_header() != 3 or u.unpack() != "output_id":
+            return None
+        output_id = u.unpack()
+        body_start = u.tell()
+        if u.unpack() != "metadata":
+            return None
+        u.skip()  # metadata subtree: bytes reused verbatim, never built
+        if u.unpack() != "data":
+            return None
+        # The data value must be built (cheap: nil, or one C-level bin
+        # copy) to learn its tag — only inline/empty payloads are
+        # routable without token bookkeeping.
+        data = u.unpack()
+        if data is not None and (
+            not isinstance(data, dict) or data.get("t") != "InlineData"
+        ):
+            return None
+        body_end = u.tell()
+        if u.unpack() != "timestamp":
+            return None
+        ts = u.unpack()
+        if not isinstance(ts, dict) or ts.get("t") != "@ts":
+            return None
+        timestamp = Timestamp.from_wire(ts["f"])
+    except Exception:
+        return None
+    return FastSend(str(output_id), bytes(frame[body_start:body_end]), timestamp)
+
+
+def build_input_event(input_id: str, body: bytes, ts: Timestamp) -> bytes:
+    """Wire image of ``Timestamped(Input(id, <body>), ts)`` — byte-equal
+    to ``serde.encode`` of the equivalent object tree."""
+    return b"".join((
+        _ENVELOPE_PREFIX,
+        _INPUT_PREFIX, _frag(input_id), body,
+        _TIMESTAMP_KEY, _timestamp_frag(ts),
+    ))
+
+
+def build_next_events_frame(event_wires: list[bytes], ts: Timestamp) -> bytes:
+    """Wire image of ``Timestamped(NextEvents(events=[...]), ts)`` from
+    per-event wire images (an empty list is the end-of-stream reply)."""
+    return b"".join((
+        _ENVELOPE_PREFIX,
+        _NEXT_EVENTS_PREFIX, _array_header(len(event_wires)),
+        *event_wires,
+        _TIMESTAMP_KEY, _timestamp_frag(ts),
+    ))

@@ -8,13 +8,23 @@ nested messages — packed with msgpack (bytes pass through zero-copy).
 This replaces the reference's serde derive + bincode/serde_json
 (libraries/message): one codec, self-describing, language-portable (the C++
 native tier uses the same layout via its own msgpack writer).
+
+Hot path: ``@message`` registration compiles a per-class pack/unpack
+closure pair (precomputed field tuples, flat exact-type dispatch tables,
+bytes passthrough) so the per-message cost is a dict build plus one dict
+lookup per value — no ``dataclasses.fields`` walk, no isinstance ladder.
+The original reflective walk (``_to_wire``/``_from_wire``) is kept as the
+fallback for values the compiled tables don't know (subclasses of builtin
+types, unregistered dataclasses) and as the golden reference the test
+suite checks the compiled codecs against byte-for-byte; the wire format
+is unchanged, so native/C nodes and old recordings interop.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import typing
-from typing import Any, Type, TypeVar
+from typing import Any, Callable, Type, TypeVar
 
 import msgpack
 
@@ -24,15 +34,126 @@ _REGISTRY: dict[str, type] = {}
 
 T = TypeVar("T")
 
+_MISSING = object()
+
+
+# ---------------------------------------------------------------------------
+# compiled codecs (exact-type dispatch; reflective walk below is the
+# fallback and the golden reference)
+# ---------------------------------------------------------------------------
+
+
+def _encode_value(value: Any) -> Any:
+    enc = _PACK.get(value.__class__)
+    if enc is not None:
+        return enc(value)
+    # Subclass of a builtin / unregistered type: reflective fallback
+    # (handles the full isinstance ladder and raises on unserializable).
+    return _to_wire(value)
+
+
+def _identity(value: Any) -> Any:
+    return value
+
+
+def _encode_seq(value: Any) -> list:
+    return [_encode_value(v) for v in value]
+
+
+def _encode_dict(value: dict) -> dict:
+    if "t" in value:
+        # Escape user dicts that would collide with the tagged-union
+        # envelope (e.g. Metadata.parameters containing a "t" key).
+        return {"t": "@map", "f": [[str(k), _encode_value(v)] for k, v in value.items()]}
+    return {str(k): _encode_value(v) for k, v in value.items()}
+
+
+def _encode_timestamp(value: Timestamp) -> dict:
+    return {"t": "@ts", "f": list(value.to_wire())}
+
+
+#: exact type -> wire encoder. Scalars pass through untouched (msgpack
+#: packs them natively); containers recurse through ``_encode_value``;
+#: ``@message`` registration adds one entry per class.
+_PACK: dict[type, Callable[[Any], Any]] = {
+    type(None): _identity,
+    bool: _identity,
+    int: _identity,
+    float: _identity,
+    str: _identity,
+    bytes: _identity,
+    bytearray: _identity,
+    memoryview: bytes,
+    list: _encode_seq,
+    tuple: _encode_seq,
+    set: _encode_seq,
+    frozenset: _encode_seq,
+    dict: _encode_dict,
+    Timestamp: _encode_timestamp,
+}
+
+#: wire tag -> compiled field decoder (``@message`` registration adds one
+#: entry per class; "@ts" / "@map" stay special-cased in _decode_value).
+_UNPACK: dict[str, Callable[[dict], Any]] = {}
+
+
+def _decode_value(value: Any) -> Any:
+    cls = value.__class__
+    if cls is dict:
+        tag = value.get("t")
+        if tag is not None:
+            up = _UNPACK.get(tag)
+            if up is not None:
+                fields = value.get("f", _MISSING)
+                if fields is not _MISSING:
+                    return up(fields)
+            elif tag == "@ts":
+                return Timestamp.from_wire(value["f"])
+            elif tag == "@map":
+                return {k: _decode_value(v) for k, v in value["f"]}
+        return {k: _decode_value(v) for k, v in value.items()}
+    if cls is list:
+        return [_decode_value(v) for v in value]
+    return value
+
+
+def _compile_codec(cls: type) -> None:
+    """Generate the per-class pack/unpack closures: field names resolved
+    once at registration, so per-message work is one dict comprehension."""
+    name = cls.__name__
+    names = tuple(f.name for f in dataclasses.fields(cls))
+
+    def pack(value, _name=name, _names=names, _enc=_encode_value):
+        return {
+            "t": _name,
+            "f": {n: _enc(getattr(value, n)) for n in _names},
+        }
+
+    known = frozenset(names)
+
+    def unpack(fields, _cls=cls, _known=known, _dec=_decode_value):
+        # Forward compatibility: ignore unknown fields.
+        return _cls(**{k: _dec(v) for k, v in fields.items() if k in _known})
+
+    _PACK[cls] = pack
+    _UNPACK[name] = unpack
+
 
 def message(cls: Type[T]) -> Type[T]:
-    """Class decorator: freeze as dataclass and register for the wire."""
+    """Class decorator: freeze as dataclass, register for the wire, and
+    compile the class's pack/unpack codec pair."""
     cls = dataclasses.dataclass(frozen=True)(cls)
     name = cls.__name__
     if name in _REGISTRY and _REGISTRY[name] is not cls:
         raise RuntimeError(f"duplicate message type name: {name}")
     _REGISTRY[name] = cls
+    _compile_codec(cls)
     return cls
+
+
+# ---------------------------------------------------------------------------
+# reflective walk (fallback + golden reference for the compiled codecs)
+# ---------------------------------------------------------------------------
 
 
 def _to_wire(value: Any) -> Any:
@@ -54,8 +175,6 @@ def _to_wire(value: Any) -> Any:
         return [_to_wire(v) for v in value]
     if isinstance(value, dict):
         if "t" in value:
-            # Escape user dicts that would collide with the tagged-union
-            # envelope (e.g. Metadata.parameters containing a "t" key).
             return {"t": "@map", "f": [[str(k), _to_wire(v)] for k, v in value.items()]}
         return {str(k): _to_wire(v) for k, v in value.items()}
     raise TypeError(f"cannot serialize {type(value).__name__}: {value!r}")
@@ -81,11 +200,11 @@ def _from_wire(value: Any) -> Any:
 
 
 def encode(msg: Any) -> bytes:
-    return msgpack.packb(_to_wire(msg), use_bin_type=True)
+    return msgpack.packb(_encode_value(msg), use_bin_type=True)
 
 
 def decode(data: bytes | memoryview) -> Any:
-    return _from_wire(msgpack.unpackb(data, raw=False, strict_map_key=False))
+    return _decode_value(msgpack.unpackb(data, raw=False, strict_map_key=False))
 
 
 # ---------------------------------------------------------------------------

@@ -145,13 +145,21 @@ class Node:
         drop_channel.request_ok(n2d.SubscribeDrop())
         self._drop_stream = _DropStream(drop_channel, self._reclaim_regions)
 
-        # Ack flusher: receiver-side drop-token acks are queued by GC
-        # finalizers and flushed as ReportDropTokens on the control channel.
+        # Opt-in output coalescing: buffer sub-threshold inline SendMessage
+        # frames on the control channel and flush them as one socket write
+        # once this many bytes are buffered (the flusher thread drains
+        # stragglers after a short linger). 0 / unset = off: every output
+        # goes out immediately.
+        self._coalesce = int(os.environ.get("DORA_SEND_COALESCE", "0") or "0")
+
+        # Flusher: receiver-side drop-token acks (queued by GC finalizers)
+        # and coalesced output frames share one timer — both drain through
+        # a single coalesced write on the control channel.
         self._ack_cond = threading.Condition()
         self._pending_acks: list[str] = []
         self._ack_closing = False
         self._ack_thread = threading.Thread(
-            target=self._ack_loop, name="dora-ack-flusher", daemon=True
+            target=self._flush_loop, name="dora-flusher", daemon=True
         )
         self._ack_thread.start()
 
@@ -299,11 +307,22 @@ class Node:
         if self._p2p is not None:
             if not self._p2p.publish(output_id, metadata, data):
                 return
-        self._control.request(
-            n2d.SendMessage(
-                output_id=output_id, metadata=metadata, data=data
-            )
-        )
+        msg = n2d.SendMessage(output_id=output_id, metadata=metadata, data=data)
+        if self._coalesce and (data is None or isinstance(data, InlineData)):
+            # Inline outputs only: shmem payloads carry drop-token
+            # lifecycle and must not sit in a sender-side buffer.
+            if self._control.queue(msg) >= self._coalesce:
+                self._control.flush()
+            else:
+                with self._ack_cond:
+                    self._ack_cond.notify()  # flusher drains after linger
+            return
+        self._control.request(msg)
+
+    def flush(self) -> None:
+        """Flush coalesced (buffered) outputs to the daemon now. No-op
+        unless coalescing is enabled (``DORA_SEND_COALESCE``)."""
+        self._control.flush()
 
     def allocate_sample(self, size: int) -> "DataSample":
         """Allocate a writable sample backed by a shared-memory region
@@ -398,16 +417,33 @@ class Node:
             if refs > 1:
                 self._token_refs[token] = refs
 
-    def _ack_loop(self) -> None:
+    #: Flusher linger: after a wake, wait this long for a burst to
+    #: accumulate before the coalesced write (only when coalescing is on).
+    FLUSH_LINGER_S = 0.0002
+
+    def _flush_loop(self) -> None:
         while True:
             with self._ack_cond:
-                while not self._pending_acks and not self._ack_closing:
+                while (
+                    not self._pending_acks
+                    and self._control.buffered_bytes == 0
+                    and not self._ack_closing
+                ):
                     self._ack_cond.wait()
-                if self._ack_closing and not self._pending_acks:
+                if (
+                    self._ack_closing
+                    and not self._pending_acks
+                    and self._control.buffered_bytes == 0
+                ):
                     return
+            if self._coalesce and not self._ack_closing:
+                time.sleep(self.FLUSH_LINGER_S)
+            with self._ack_cond:
                 tokens, self._pending_acks = self._pending_acks, []
             try:
-                self._control.request(n2d.ReportDropTokens(drop_tokens=tokens))
+                if tokens:
+                    self._control.queue(n2d.ReportDropTokens(drop_tokens=tokens))
+                self._control.flush()
             except Exception:
                 return
 

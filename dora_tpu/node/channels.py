@@ -16,7 +16,7 @@ from dora_tpu.message import daemon_to_node as d2n
 from dora_tpu.message import node_to_daemon as n2d
 from dora_tpu.message.serde import decode_timestamped, encode_timestamped
 from dora_tpu.native import Disconnected, ShmemChannel
-from dora_tpu.transport.framing import recv_frame, send_frame
+from dora_tpu.transport.framing import recv_frame, send_frame, send_frames
 
 
 class DaemonError(RuntimeError):
@@ -29,6 +29,9 @@ class _SocketTransport:
 
     def send(self, payload: bytes) -> None:
         send_frame(self.sock, payload)
+
+    def send_many(self, payloads: list[bytes]) -> None:
+        send_frames(self.sock, payloads)
 
     def recv(self) -> bytes:
         return recv_frame(self.sock)
@@ -52,6 +55,13 @@ class _ShmemTransport:
     def send(self, payload: bytes) -> None:
         self.channel.send(payload)
 
+    def send_many(self, payloads: list[bytes]) -> None:
+        # The shmem channel is message-oriented (one slot per message), so
+        # frames can't be joined — but draining the buffer in one locked
+        # pass still amortizes the Python-level per-send overhead.
+        for payload in payloads:
+            self.channel.send(payload)
+
     def recv(self) -> bytes:
         data = self.channel.recv(timeout=None)
         if data is None:  # pragma: no cover - no-timeout recv returns data
@@ -70,12 +80,21 @@ class _ShmemTransport:
 
 
 class DaemonChannel:
-    """One registered request-reply channel to the daemon."""
+    """One registered request-reply channel to the daemon.
+
+    Fire-and-forget messages (no reply expected) may be buffered with
+    ``queue()`` and flushed as one coalesced transport write — one
+    syscall for the whole batch on socket transports. ``request()``
+    always flushes the buffer first, so the daemon observes the same
+    message order as the un-coalesced path.
+    """
 
     def __init__(self, transport, clock):
         self._transport = transport
         self._clock = clock
         self._lock = threading.Lock()
+        self._pending: list[bytes] = []
+        self._pending_bytes = 0
         self.closed = False
 
     # -- construction -------------------------------------------------------
@@ -118,15 +137,41 @@ class DaemonChannel:
 
     # -- requests -----------------------------------------------------------
 
+    def _flush_locked(self) -> None:
+        if self._pending:
+            pending, self._pending = self._pending, []
+            self._pending_bytes = 0
+            self._transport.send_many(pending)
+
     def request(self, msg: Any) -> Any:
         """Send one request and (if the message type expects it) wait for the
-        reply."""
+        reply. Buffered fire-and-forget frames flush first (ordering)."""
         with self._lock:
+            self._flush_locked()
             self._transport.send(encode_timestamped(msg, self._clock))
             if not n2d.expects_reply(msg):
                 return None
             frame = self._transport.recv()
         return decode_timestamped(frame, self._clock).inner
+
+    def queue(self, msg: Any) -> int:
+        """Buffer a fire-and-forget message for a later coalesced flush.
+        Returns the buffered byte count (caller decides when to flush)."""
+        assert not n2d.expects_reply(msg), "only fire-and-forget can be queued"
+        frame = encode_timestamped(msg, self._clock)
+        with self._lock:
+            self._pending.append(frame)
+            self._pending_bytes += len(frame)
+            return self._pending_bytes
+
+    def flush(self) -> None:
+        """Send every buffered frame in one coalesced transport write."""
+        with self._lock:
+            self._flush_locked()
+
+    @property
+    def buffered_bytes(self) -> int:
+        return self._pending_bytes
 
     def request_ok(self, msg: Any) -> None:
         reply = self.request(msg)
@@ -142,4 +187,8 @@ class DaemonChannel:
         interrupt() and join the consuming thread first."""
         if not self.closed:
             self.closed = True
+            try:
+                self.flush()  # best-effort: don't strand buffered outputs
+            except Exception:
+                pass
             self._transport.close()

@@ -118,12 +118,23 @@ class _EdgeServer:
 
     def _drain(self) -> None:
         events = self.endpoint.events
+        first = True
         while self.backlog:
             input_id, event = self.backlog[0]
             try:
-                events._queue.put_nowait(event)
+                # Block briefly on the FIRST put: when the consumer is
+                # the bottleneck this hands the event over the moment a
+                # queue slot frees instead of sleeping out a recv tick
+                # (the 10 ms poll capped a backlogged edge at ~200
+                # events/s; the sender is flow-controlled to one
+                # outstanding frame either way).
+                if first:
+                    events._queue.put(event, timeout=0.01)
+                else:
+                    events._queue.put_nowait(event)
             except queue_mod.Full:
                 return
+            first = False
             self.backlog.popleft()
             self.counts[input_id] -= 1
 

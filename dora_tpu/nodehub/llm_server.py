@@ -92,8 +92,14 @@ def main() -> None:
     wire_ids: dict[str, str | None] = {}
     anon_counter = [0]
 
-    def emit_text(key: str, text: str, done: bool) -> None:
+    def emit_text(
+        key: str, text: str, done: bool, finish: str | None = None
+    ) -> None:
         meta: dict = {"done": bool(done)}
+        if done:
+            # Done-by-EOS ("stop") vs done-by-cap ("length"): the server
+            # reports this as the OpenAI finish_reason.
+            meta["finish"] = finish or "stop"
         rid = wire_ids.get(key)
         if rid is not None:
             meta["request_id"] = rid
@@ -102,7 +108,10 @@ def main() -> None:
             wire_ids.pop(key, None)
 
     def emit(key: str, token: int, done: bool) -> None:
-        emit_text(key, decode_one(token), done)
+        finish = None
+        if done:
+            finish = "stop" if (eos is not None and token == eos) else "length"
+        emit_text(key, decode_one(token), done, finish)
 
     def start(key: str, ids: list[int], max_new: int) -> None:
         token, done = engine.submit(key, ids, max_new)
@@ -139,10 +148,14 @@ def main() -> None:
                         int(meta.get("max_new_tokens", max_new_cap)),
                         max_new_cap,
                     )
-                    if not engine.fits(len(ids), max_new):
+                    if max_new <= 0:
+                        # max_tokens <= 0 asks for nothing: close the
+                        # stream empty instead of fabricating a token.
+                        emit_text(key, "", True, finish="length")
+                    elif not engine.fits(len(ids), max_new):
                         # Oversized: close the stream empty — never
                         # fabricate a token as a "successful" answer.
-                        emit_text(key, "", True)
+                        emit_text(key, "", True, finish="length")
                     elif not engine.free_slots:
                         backlog.append((key, ids, max_new))
                     else:

@@ -172,9 +172,10 @@ def main() -> None:
                     self._sse_chunk(model, {"role": "assistant"})
                 parts: list[str] = []
                 finished = False
+                finish_reason = None  # responder's tag: "stop" | "length"
                 while True:
                     try:
-                        delta, done = chunks.get(timeout=timeout_s)
+                        delta, done, finish = chunks.get(timeout=timeout_s)
                     except queue.Empty:
                         if not stream:
                             # Stalled mid-answer: a truncated completion
@@ -192,13 +193,19 @@ def main() -> None:
                             parts.append(delta)
                     if done:
                         finished = True
+                        finish_reason = finish
                         break
                 if stream:
-                    # A stream that timed out before the responder's
-                    # done marker is truncated: say so ("length"), don't
-                    # claim a clean stop.
+                    # Prefer the responder's own tag (done-by-EOS =
+                    # "stop", done-by-cap = "length"); a stream that
+                    # timed out before the done marker is truncated:
+                    # say so ("length"), don't claim a clean stop.
                     self._sse_chunk(
-                        model, {}, finish="stop" if finished else "length"
+                        model,
+                        {},
+                        finish=(finish_reason or "stop")
+                        if finished
+                        else "length",
                     )
                     self.wfile.write(b"data: [DONE]\n\n")
                 else:
@@ -215,7 +222,7 @@ def main() -> None:
                                         "role": "assistant",
                                         "content": "".join(parts),
                                     },
-                                    "finish_reason": "stop",
+                                    "finish_reason": finish_reason or "stop",
                                 }
                             ],
                         }
@@ -286,7 +293,9 @@ def main() -> None:
                 with routed_lock:
                     target = routed.get(rid)
                 if target is not None:  # client gone: drop silently
-                    target.put((answer, bool(meta.get("done"))))
+                    target.put(
+                        (answer, bool(meta.get("done")), meta.get("finish"))
+                    )
                 continue
             responses.put(answer)
     finally:

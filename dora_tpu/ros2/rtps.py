@@ -669,7 +669,12 @@ class RtpsParticipant:
                 if seq < proxy.next_seq or seq in proxy.pending:
                     continue  # duplicate (retransmission overlap)
                 proxy.pending[seq] = body
-                self._drain_proxy(r, proxy)
+                ready = self._drain_proxy(proxy)
+            # Deliver OUTSIDE the participant lock (like the best-effort
+            # path above): a reader callback that re-enters this
+            # participant would otherwise deadlock.
+            for deliverable in ready:
+                self._deliver(r, deliverable)
 
     def _deliver(self, reader: "_Reader", body: bytes) -> None:
         if reader.callback is not None:
@@ -677,14 +682,18 @@ class RtpsParticipant:
         else:
             reader.history.append(body)
 
-    def _drain_proxy(self, reader: "_Reader", proxy: "_WriterProxy") -> None:
-        """Deliver the contiguous run at the head of the pending buffer
-        (None entries are GAP-declared irrelevant sequences)."""
+    def _drain_proxy(self, proxy: "_WriterProxy") -> list[bytes]:
+        """Pop the contiguous run at the head of the pending buffer and
+        return its deliverable bodies (None entries are GAP-declared
+        irrelevant sequences). Caller holds the lock and must deliver
+        only after releasing it."""
+        ready: list[bytes] = []
         while proxy.next_seq in proxy.pending:
             body = proxy.pending.pop(proxy.next_seq)
             proxy.next_seq += 1
             if body is not None:
-                self._deliver(reader, body)
+                ready.append(body)
+        return ready
 
     # -- reliable protocol ---------------------------------------------------
 
@@ -696,10 +705,13 @@ class RtpsParticipant:
         last = self._parse_sn(body, 16)
         (count,) = struct.unpack_from("<i", body, 24)
         writer_guid = src_prefix + struct.pack(">I", writer_ent)
+        deliveries: list[tuple["_Reader", bytes]] = []
+        acks: list[bytes] = []
         with self._lock:
             ep = self._remote_writers.get(writer_guid)
             if ep is None or not ep.reliable or ep.locator is None:
                 return
+            locator = ep.locator
             targets = [
                 r for r in self._readers.values()
                 if r.topic == ep.topic and r.reliable
@@ -714,22 +726,28 @@ class RtpsParticipant:
                 # anything already buffered out-of-order DID arrive and
                 # must still be delivered, in order.
                 while proxy.next_seq < first:
-                    body = proxy.pending.pop(proxy.next_seq, None)
+                    buffered = proxy.pending.pop(proxy.next_seq, None)
                     proxy.next_seq += 1
-                    if body is not None:
-                        self._deliver(r, body)
-                self._drain_proxy(r, proxy)
+                    if buffered is not None:
+                        deliveries.append((r, buffered))
+                deliveries.extend((r, b) for b in self._drain_proxy(proxy))
                 missing = [
                     s for s in range(proxy.next_seq, last + 1)
                     if s not in proxy.pending
                 ]
                 proxy.acknack_count += 1
-                ack = self._acknack_submsg(
+                acks.append(self._acknack_submsg(
                     r.entity_id, writer_ent,
                     missing[0] if missing else last + 1,
                     missing, proxy.acknack_count,
-                )
-                self._send(ep.locator, ack)
+                ))
+        # Callbacks and socket sends happen outside the lock: a callback
+        # re-entering the participant (or a blocking send) must never
+        # hold up discovery/delivery on other threads.
+        for r, deliverable in deliveries:
+            self._deliver(r, deliverable)
+        for ack in acks:
+            self._send(locator, ack)
 
     def _on_acknack(self, src_prefix: bytes, body: bytes) -> None:
         """Resend requested sequences from history; GAP the evicted."""
@@ -781,6 +799,7 @@ class RtpsParticipant:
         start = self._parse_sn(body, 8)
         list_base = self._parse_sn(body, 16)
         writer_guid = src_prefix + struct.pack(">I", writer_ent)
+        deliveries: list[tuple["_Reader", bytes]] = []
         with self._lock:
             ep = self._remote_writers.get(writer_guid)
             if ep is None:
@@ -791,7 +810,9 @@ class RtpsParticipant:
                 proxy = r.proxies.setdefault(writer_guid, _WriterProxy())
                 for s in range(max(start, proxy.next_seq), list_base):
                     proxy.pending.setdefault(s, None)
-                self._drain_proxy(r, proxy)
+                deliveries.extend((r, b) for b in self._drain_proxy(proxy))
+        for r, deliverable in deliveries:
+            self._deliver(r, deliverable)
 
     # -- public API ---------------------------------------------------------
 

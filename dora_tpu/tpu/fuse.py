@@ -314,9 +314,11 @@ class FusedExecutor:
                 self.states[op_id] = jax.device_put(op.init_state)
         #: latest device value per external data input (latest-wins sampling)
         self.latest: dict[str, Any] = {}
-        #: futures of in-flight tick emissions, oldest first; each future
-        #: resolves to a LIST of tick-output dicts (fetch groups)
-        self._in_flight: list[Any] = []
+        #: in-flight tick emissions as (future, n_ticks) pairs, oldest
+        #: first; each future resolves to a LIST of tick-output dicts
+        #: (fetch groups). Guarded by _stage_lock (harvest/backpressure
+        #: run on the event thread, submission on the linger timer's).
+        self._in_flight: list[tuple[Any, int]] = []
         self._fetch_pool = None
         #: device-side output ring: tick outputs staged for the next
         #: grouped fetch (fetch_every > 1 — see fetch_every_from_env)
@@ -453,19 +455,18 @@ class FusedExecutor:
         # not dropped — it stays queued for the next harvest in order.
         limit = self.pipeline_depth + self.fetch_every - 1
         while self._unfetched_ticks() > limit:
-            oldest = next(
-                (f for f in self._in_flight if not f.done()), None
-            )
+            with self._stage_lock:
+                oldest = next(
+                    (f for f, _ in self._in_flight if not f.done()), None
+                )
             if oldest is None:
                 break
-            oldest.result()
+            oldest.result()  # wait outside the lock
 
     def _unfetched_ticks(self) -> int:
         with self._stage_lock:
             pending = sum(
-                getattr(f, "dora_ticks", 1)
-                for f in self._in_flight
-                if not f.done()
+                n for f, n in self._in_flight if not f.done()
             )
             return pending + len(self._staged)
 
@@ -492,9 +493,11 @@ class FusedExecutor:
                 key: jnp.stack([tick[key] for tick in staged])
                 for key in staged[0]
             }
+        # The tick count travels as a submit argument AND in the
+        # in-flight pair — never attached to the future post-submit
+        # (a worker could observe the future before the attribute).
         future = self._fetch_pool.submit(self._emit, payload, len(staged))
-        future.dora_ticks = len(staged)
-        self._in_flight.append(future)
+        self._in_flight.append((future, len(staged)))
         if self.on_fetch_done is not None:
             future.add_done_callback(lambda _f: self.on_fetch_done())
 
@@ -523,7 +526,8 @@ class FusedExecutor:
 
     @property
     def has_in_flight(self) -> bool:
-        return bool(self._in_flight) or bool(self._staged)
+        with self._stage_lock:
+            return bool(self._in_flight) or bool(self._staged)
 
     def harvest(self, block: bool = False) -> list[dict]:
         """Completed tick outputs in dispatch order. Non-blocking by
@@ -533,8 +537,15 @@ class FusedExecutor:
         if block:
             self._submit_group()
         done: list[dict] = []
-        while self._in_flight and (block or self._in_flight[0].done()):
-            done.extend(self._in_flight.pop(0).result())
+        while True:
+            with self._stage_lock:
+                if not self._in_flight:
+                    break
+                future, _ = self._in_flight[0]
+                if not (block or future.done()):
+                    break
+                self._in_flight.pop(0)
+            done.extend(future.result())  # may wait: outside the lock
         return done
 
     def close(self) -> None:
@@ -543,14 +554,14 @@ class FusedExecutor:
         so their device buffers are not abandoned mid-copy."""
         with self._stage_lock:
             timer, self._linger_timer = self._linger_timer, None
+            in_flight, self._in_flight = self._in_flight, []
         if timer is not None:
             timer.cancel()
         if self._fetch_pool is not None:
-            for future in self._in_flight:
+            for future, _ in in_flight:
                 try:
                     future.result()
                 except Exception:
                     pass
-            self._in_flight.clear()
             self._fetch_pool.shutdown(wait=True)
             self._fetch_pool = None

@@ -14,6 +14,11 @@ import struct
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
 
+#: Below this, header+payload are joined into ONE buffer before writing
+#: (one syscall / transport.write); above, the copy would cost more than
+#: the extra write it saves.
+_JOIN_LIMIT = 1 << 16
+
 
 class ConnectionClosed(ConnectionError):
     pass
@@ -26,6 +31,21 @@ class ConnectionClosed(ConnectionError):
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def send_frames(sock: socket.socket, payloads: list[bytes]) -> None:
+    """Coalesced send: every frame in one sendall (one syscall for the
+    whole batch). The receiver's framed recv loop splits them back out —
+    frame boundaries are length-prefixed, so batching is invisible on
+    the wire."""
+    if len(payloads) == 1:
+        send_frame(sock, payloads[0])
+        return
+    buf = bytearray()
+    for payload in payloads:
+        buf += _LEN.pack(len(payload))
+        buf += payload
+    sock.sendall(buf)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -52,8 +72,27 @@ def recv_frame(sock: socket.socket) -> bytes:
 
 
 async def send_frame_async(writer: asyncio.StreamWriter, payload: bytes) -> None:
-    writer.write(_LEN.pack(len(payload)))
-    writer.write(payload)
+    if len(payload) < _JOIN_LIMIT:
+        # One write call = one transport send attempt; two write calls on
+        # an empty buffer can each hit the socket (two syscalls per reply
+        # on the request/reply hot path).
+        writer.write(_LEN.pack(len(payload)) + payload)
+    else:
+        writer.write(_LEN.pack(len(payload)))
+        writer.write(payload)
+    await writer.drain()
+
+
+async def send_frames_async(
+    writer: asyncio.StreamWriter, payloads: list[bytes]
+) -> None:
+    """Coalesced async send: all frames through one writelines + one
+    drain (vectored into the transport buffer, flushed together)."""
+    bufs: list[bytes] = []
+    for payload in payloads:
+        bufs.append(_LEN.pack(len(payload)))
+        bufs.append(payload)
+    writer.writelines(bufs)
     await writer.drain()
 
 

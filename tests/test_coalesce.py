@@ -1,0 +1,244 @@
+"""Coalesced I/O: batched framing, channel buffering, and the daemon's
+wire fast path — including proof that drop-oldest semantics survive
+coalesced delivery.
+"""
+
+import asyncio
+import socket
+import threading
+
+import pytest
+
+from dora_tpu.clock import HLC
+from dora_tpu.daemon.queues import NodeEventQueue
+from dora_tpu.message import daemon_to_node as d2n
+from dora_tpu.message import fastroute
+from dora_tpu.message import node_to_daemon as n2d
+from dora_tpu.message.common import InlineData, Metadata, TypeInfo
+from dora_tpu.message.serde import Timestamped, decode, encode
+from dora_tpu.node.channels import DaemonChannel, _SocketTransport
+from dora_tpu.transport.framing import (
+    recv_frame,
+    recv_frame_async,
+    send_frames,
+    send_frames_async,
+)
+
+
+def _md(**params) -> Metadata:
+    return Metadata(type_info=TypeInfo(encoding="raw", len=0), parameters=params)
+
+
+def _send_frame_wire(clock, seq: int, payload: bytes = b"") -> bytes:
+    msg = n2d.SendMessage(
+        output_id="out", metadata=_md(seq=seq), data=InlineData(data=payload)
+    )
+    return encode(Timestamped(inner=msg, timestamp=clock.new_timestamp()))
+
+
+# ---------------------------------------------------------------------------
+# framing: one coalesced write, N frames on the receive side
+# ---------------------------------------------------------------------------
+
+
+def test_send_frames_splits_back_into_frames():
+    a, b = socket.socketpair()
+    payloads = [b"", b"x", b"hello" * 100, bytes(range(256))]
+    t = threading.Thread(target=send_frames, args=(a, payloads))
+    t.start()
+    for p in payloads:
+        assert recv_frame(b) == p
+    t.join()
+    a.close()
+    b.close()
+
+
+def test_send_frames_async_splits_back_into_frames():
+    async def main():
+        received = []
+        got_all = asyncio.Event()
+        payloads = [b"a", b"", b"b" * 70_000, b"c"]
+
+        async def handler(reader, writer):
+            for _ in payloads:
+                received.append(await recv_frame_async(reader))
+            got_all.set()
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        _, writer = await asyncio.open_connection("127.0.0.1", port)
+        await send_frames_async(writer, payloads)
+        await asyncio.wait_for(got_all.wait(), 5)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        assert received == payloads
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# DaemonChannel buffering
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_channel_queue_flush_preserves_order():
+    a, b = socket.socketpair()
+    clock = HLC("node")
+    receiver = HLC("daemon")
+    chan = DaemonChannel(_SocketTransport(a), clock)
+
+    sent = []
+    for seq in range(5):
+        msg = n2d.SendMessage(
+            output_id="out", metadata=_md(seq=seq), data=InlineData(data=b"p")
+        )
+        sent.append(msg)
+        assert chan.queue(msg) > 0
+    assert chan.buffered_bytes > 0
+    chan.flush()
+    assert chan.buffered_bytes == 0
+
+    from dora_tpu.message.serde import decode_timestamped
+
+    got = [decode_timestamped(recv_frame(b), receiver).inner for _ in range(5)]
+    assert got == sent
+    chan.close()
+    b.close()
+
+
+def test_daemon_channel_request_flushes_buffered_first():
+    """A request must never overtake buffered fire-and-forget frames."""
+    a, b = socket.socketpair()
+    clock = HLC("node")
+    chan = DaemonChannel(_SocketTransport(a), clock)
+    chan.queue(n2d.ReportDropTokens(drop_tokens=["t1"]))
+
+    def serve():
+        receiver = HLC("daemon")
+        from dora_tpu.message.serde import decode_timestamped, encode_timestamped
+
+        first = decode_timestamped(recv_frame(b), receiver).inner
+        second = decode_timestamped(recv_frame(b), receiver).inner
+        assert isinstance(first, n2d.ReportDropTokens)
+        assert isinstance(second, n2d.Subscribe)
+        b.sendall(
+            len(
+                frame := encode_timestamped(d2n.ReplyResult(), receiver)
+            ).to_bytes(4, "little")
+            + frame
+        )
+
+    t = threading.Thread(target=serve)
+    t.start()
+    reply = chan.request(n2d.Subscribe())
+    t.join()
+    assert isinstance(reply, d2n.ReplyResult)
+    chan.close()
+    b.close()
+
+
+def test_queue_rejects_request_reply_messages():
+    a, b = socket.socketpair()
+    chan = DaemonChannel(_SocketTransport(a), HLC("node"))
+    with pytest.raises(AssertionError):
+        chan.queue(n2d.Subscribe())
+    chan.close()
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# drop-oldest survives coalesced (wire fast path) delivery
+# ---------------------------------------------------------------------------
+
+
+def test_drop_oldest_survives_coalesced_wire_delivery():
+    """Route a burst of coalesced SendMessage frames through the wire
+    fast path into a bounded queue: the per-input drop-oldest contract
+    must hold, and the spliced NextEvents reply must decode to exactly
+    the surviving (newest) events in order."""
+    clock = HLC("sender")
+    daemon_clock = HLC("daemon")
+    dropped: list[str] = []
+    queue = NodeEventQueue(
+        node_id="sink",
+        queue_sizes={"data": 3},
+        on_token_unref=dropped.append,
+    )
+
+    for seq in range(8):  # 8 pushes into a 3-deep input
+        fast = fastroute.parse_send_message(_send_frame_wire(clock, seq))
+        assert fast is not None
+        queue.push(
+            None,
+            input_id="data",
+            wire=fastroute.build_input_event(
+                "data", fast.body, daemon_clock.new_timestamp()
+            ),
+        )
+
+    assert queue.input_counts["data"] == 3
+    batch = asyncio.run(queue.next_batch())
+    assert len(batch) == 3
+    reply = fastroute.build_next_events_frame(
+        [e.wire for e in batch], daemon_clock.new_timestamp()
+    )
+    env = decode(reply)
+    assert isinstance(env.inner, d2n.NextEvents)
+    seqs = [ev.inner.metadata.parameters["seq"] for ev in env.inner.events]
+    assert seqs == [5, 6, 7]  # oldest 0..4 were shed, order preserved
+    assert queue.input_counts["data"] == 0
+
+
+def test_max_batch_is_a_frame_ceiling_not_the_staleness_bound():
+    """A batch can hand out at most queue_size events of one input no
+    matter how large MAX_BATCH is — the push-time bound caps exposure."""
+    queue = NodeEventQueue(
+        node_id="n", queue_sizes={"cam": 1}, on_token_unref=lambda t: None
+    )
+    clock = HLC("d")
+    for seq in range(5):
+        queue.push(
+            Timestamped(
+                inner=d2n.Input(id="cam", metadata=_md(seq=seq), data=None),
+                timestamp=clock.new_timestamp(),
+            ),
+            input_id="cam",
+        )
+    batch = asyncio.run(queue.next_batch())
+    assert len(batch) == 1  # queue_size=1: latest-wins even at MAX_BATCH=64
+    assert batch[0].event.inner.metadata.parameters["seq"] == 4
+
+
+def test_mixed_wire_and_object_entries_share_one_reply():
+    """Timer ticks (object entries) and routed outputs (wire entries)
+    interleave in one queue; the reply encoder handles both."""
+    from dora_tpu.message.serde import encode as serde_encode
+
+    clock = HLC("sender")
+    daemon_clock = HLC("daemon")
+    queue = NodeEventQueue(
+        node_id="n", queue_sizes={}, on_token_unref=lambda t: None
+    )
+    tick = Timestamped(
+        inner=d2n.Input(id="tick", metadata=_md(timer="10ms"), data=None),
+        timestamp=daemon_clock.new_timestamp(),
+    )
+    queue.push(tick, input_id="tick")
+    fast = fastroute.parse_send_message(_send_frame_wire(clock, 0, b"xyz"))
+    queue.push(
+        None,
+        input_id="data",
+        wire=fastroute.build_input_event(
+            "data", fast.body, daemon_clock.new_timestamp()
+        ),
+    )
+    batch = asyncio.run(queue.next_batch())
+    wires = [
+        e.wire if e.wire is not None else serde_encode(e.event) for e in batch
+    ]
+    env = decode(fastroute.build_next_events_frame(wires, daemon_clock.new_timestamp()))
+    ids = [ev.inner.id for ev in env.inner.events]
+    assert ids == ["tick", "data"]
+    assert env.inner.events[1].inner.data == InlineData(data=b"xyz")
