@@ -31,6 +31,8 @@ Prints exactly ONE JSON line (the last line of stdout):
    "runs": N, "spread_us": [lo, hi], "baseline_us": ...,
    "msgs_per_sec_1kib": {"daemon": ..., "p2p": ...},
    "p50_us_1kib": {...}, "p99_us_1kib": {...},
+   "recorder_ab": {"off_msgs_per_sec": ..., "on_msgs_per_sec": ...,
+                   "overhead_pct": ...},
    "e2e_fps": ..., "e2e_vs_north_star": ...}
 """
 
@@ -177,7 +179,9 @@ def dataflow_p50_us(workdir: Path) -> float:
     return json.loads((workdir / "latency.json").read_text())
 
 
-def small_message_run(workdir: Path, route: str) -> dict:
+def small_message_run(
+    workdir: Path, route: str, extra_env: dict | None = None
+) -> dict:
     """One 1 KiB x MSG_COUNT run through src -> relay -> sink.
 
     route "daemon": tcp node channels, p2p edges off — every message
@@ -315,6 +319,8 @@ def small_message_run(workdir: Path, route: str) -> dict:
         "DORA_P2P": "0" if route == "daemon" else "1",
         "DORA_SEND_COALESCE": os.environ.get("DORA_SEND_COALESCE", "8192"),
     }
+    if extra_env:
+        overrides.update(extra_env)
     saved = {k: os.environ.get(k) for k in overrides}
     os.environ.update(overrides)
     try:
@@ -353,6 +359,38 @@ def small_message_leg(route: str) -> dict:
         "p50_us": round(statistics.median(r["p50_us"] for r in runs), 1),
         "p99_us": round(statistics.median(r["p99_us"] for r in runs), 1),
         "received": min(r["received"] for r in runs),
+    }
+
+
+def recorder_ab_leg() -> dict:
+    """Flight-recorder A/B on the daemon route: off vs DORA_FLIGHT_RECORDER=1,
+    runs interleaved so both sides see the same machine conditions. The
+    recorder's hot-path budget is ≤3% on msgs_per_sec (daemon route)."""
+    off: list[float] = []
+    on: list[float] = []
+    for i in range(SMALL_RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-rec-") as tmp:
+            off.append(small_message_run(Path(tmp), "daemon")["msgs_per_sec"])
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-rec-") as tmp:
+            on.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={"DORA_FLIGHT_RECORDER": "1"},
+                )["msgs_per_sec"]
+            )
+        print(
+            f"# recorder A/B run {i + 1}/{SMALL_RUNS}: "
+            f"off {off[-1]:.0f} msg/s, on {on[-1]:.0f} msg/s",
+            file=sys.stderr,
+        )
+    off_m = statistics.median(off)
+    on_m = statistics.median(on)
+    return {
+        "off_msgs_per_sec": round(off_m, 0),
+        "on_msgs_per_sec": round(on_m, 0),
+        "overhead_pct": (
+            round((off_m - on_m) / off_m * 100, 2) if off_m else None
+        ),
     }
 
 
@@ -480,6 +518,16 @@ def main() -> int:
             }
 
     try:
+        recorder_ab = recorder_ab_leg()
+    except Exception as exc:
+        recorder_ab = {
+            "off_msgs_per_sec": None,
+            "on_msgs_per_sec": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -509,6 +557,7 @@ def main() -> int:
         "p50_us_1kib": {route: small[route]["p50_us"] for route in small},
         "p99_us_1kib": {route: small[route]["p99_us"] for route in small},
         "small_msg_detail": small,
+        "recorder_ab": recorder_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

@@ -358,6 +358,39 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Aggregated per-link counters and latency percentiles for a dataflow
+    (``--watch`` refreshes top-style with rates from counter deltas)."""
+    import json
+
+    from dora_tpu.cli.metrics_view import render_metrics
+
+    with _control(args) as c:
+        prev = None
+        while True:
+            reply = c.request(
+                cm.QueryMetrics(dataflow_uuid=args.uuid, name=args.name)
+            )
+            if isinstance(reply, cm.Error):
+                print(reply.message, file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(reply.metrics, indent=2, sort_keys=True))
+                return 0
+            text = render_metrics(
+                reply.dataflow_uuid,
+                reply.metrics,
+                prev=prev,
+                interval=args.interval if args.watch else None,
+            )
+            if not args.watch:
+                print(text, end="")
+                return 0
+            print("\x1b[2J\x1b[H" + text, end="", flush=True)
+            prev = reply.metrics
+            time.sleep(args.interval)
+
+
 def cmd_logs(args) -> int:
     with _control(args) as c:
         reply = c.request(cm.Logs(uuid=args.uuid, name=args.name, node=args.node))
@@ -483,6 +516,23 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("list", help="list running dataflows")
     coordinator_addr(p)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser(
+        "metrics", help="show a dataflow's routing/latency metrics"
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument(
+        "--watch", action="store_true", help="refresh top-style with rates"
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, help="--watch refresh seconds"
+    )
+    p.add_argument(
+        "--json", action="store_true", help="print the raw merged snapshot"
+    )
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("logs", help="print a node's logs")
     p.add_argument("node")

@@ -1,0 +1,105 @@
+"""Render an aggregated metrics snapshot (dora_tpu.metrics) as a
+top-style text table for ``dora-tpu metrics [--watch]``.
+
+Pure formatting — no I/O, no control-plane types — so tests can feed it
+snapshots directly and the CLI stays a thin loop.
+"""
+
+from __future__ import annotations
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _fmt_us(us: float | None) -> str:
+    if us is None:
+        return "-"
+    if us < 1000:
+        return f"{us:.0f}µs"
+    if us < 1_000_000:
+        return f"{us / 1000:.1f}ms"
+    return f"{us / 1_000_000:.2f}s"
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> list[str]:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()]
+    for row in rows:
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+        )
+    return lines
+
+
+def render_metrics(
+    uuid: str,
+    snap: dict,
+    prev: dict | None = None,
+    interval: float | None = None,
+) -> str:
+    """One screenful: header (fastroute ratio), per-link throughput table,
+    per-input latency/backlog table. ``prev`` + ``interval`` (watch mode)
+    turn counter deltas into msg/s / bytes/s rates."""
+    fr = snap.get("fastroute", {})
+    ratio = fr.get("hit_ratio")
+    header = f"dataflow {uuid}"
+    if ratio is not None:
+        header += (
+            f"   fastroute {ratio * 100:.1f}% "
+            f"({fr.get('hits', 0)} hits / {fr.get('fallbacks', 0)} fallbacks)"
+        )
+    reasons = fr.get("fallback_reasons") or {}
+    if reasons:
+        listed = ", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+        header += f"\n  fallback reasons: {listed}"
+    lines = [header, ""]
+
+    prev_links = (prev or {}).get("links", {})
+    link_rows = []
+    for key in sorted(snap.get("links", {})):
+        v = snap["links"][key]
+        row = [key, str(v.get("msgs", 0)), _fmt_bytes(v.get("bytes", 0))]
+        if interval:
+            before = prev_links.get(key, {})
+            rate = (v.get("msgs", 0) - before.get("msgs", 0)) / interval
+            brate = (v.get("bytes", 0) - before.get("bytes", 0)) / interval
+            row += [f"{rate:.1f}", f"{_fmt_bytes(brate)}/s"]
+        link_rows.append(row)
+    headers = ["LINK", "MSGS", "BYTES"]
+    if interval:
+        headers += ["MSG/S", "BYTES/S"]
+    if link_rows:
+        lines += _table(headers, link_rows) + [""]
+    else:
+        lines += ["(no routed links yet)", ""]
+
+    drops = snap.get("drops", {})
+    depths = snap.get("queue_depth", {})
+    latency = snap.get("latency_us", {})
+    input_keys = sorted(set(drops) | set(depths) | set(latency))
+    input_rows = []
+    for key in input_keys:
+        h = latency.get(key, {})
+        input_rows.append([
+            key,
+            str(depths.get(key, 0)),
+            str(drops.get(key, 0)),
+            _fmt_us(h.get("p50_us")),
+            _fmt_us(h.get("p90_us")),
+            _fmt_us(h.get("p99_us")),
+            str(h.get("count", 0)),
+        ])
+    if input_rows:
+        lines += _table(
+            ["INPUT", "DEPTH", "DROPS", "P50", "P90", "P99", "DELIVERED"],
+            input_rows,
+        )
+    return "\n".join(lines).rstrip() + "\n"

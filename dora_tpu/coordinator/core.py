@@ -85,6 +85,8 @@ class Coordinator:
         self._destroyed = asyncio.Event()
         #: correlation for log-file requests: (dataflow_id, node_id) -> future
         self._log_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        #: correlation for metrics requests: (dataflow_id, machine) -> future
+        self._metrics_waiters: dict[tuple[str, str], asyncio.Future] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -200,6 +202,10 @@ class Coordinator:
             self._publish_log(event.log)
         elif isinstance(event, cm.LogsReplyFromDaemon):
             self.deliver_logs_reply(event.dataflow_id, event.node_id, event.logs)
+        elif isinstance(event, cm.MetricsReplyFromDaemon):
+            fut = self._metrics_waiters.get((event.dataflow_id, event.machine_id))
+            if fut is not None and not fut.done():
+                fut.set_result(event.metrics)
         else:
             logger.warning("unexpected daemon event %s", type(event).__name__)
 
@@ -334,9 +340,17 @@ class Coordinator:
         matches = [u for u, df in self.running.items() if df.name == name_or_uuid]
         if len(matches) == 1:
             return matches[0]
-        if not matches:
-            raise KeyError(f"no dataflow named {name_or_uuid!r}")
-        raise KeyError(f"multiple running dataflows named {name_or_uuid!r}")
+        if len(matches) > 1:
+            raise KeyError(f"multiple running dataflows named {name_or_uuid!r}")
+        # Finished dataflows stay addressable by name: logs and metrics
+        # are explicitly queryable after completion (most recent wins —
+        # archived insertion order is completion order).
+        archived = [
+            u for u, (df, _) in self.archived.items() if df.name == name_or_uuid
+        ]
+        if archived:
+            return archived[-1]
+        raise KeyError(f"no dataflow named {name_or_uuid!r}")
 
     async def request_logs(self, uuid: str, node_id: str) -> bytes:
         df = self.running.get(uuid)
@@ -358,6 +372,33 @@ class Coordinator:
         fut = self._log_waiters.get((uuid, node_id))
         if fut is not None and not fut.done():
             fut.set_result(logs)
+
+    async def request_metrics(self, uuid: str) -> dict:
+        """Fan a MetricsRequest out to every involved daemon and merge the
+        per-machine snapshots (dora_tpu.metrics.merge_snapshots). Works for
+        archived dataflows too — daemons keep finished dataflow state."""
+        from dora_tpu.metrics import merge_snapshots
+
+        df = self.running.get(uuid)
+        if df is None and uuid in self.archived:
+            df = self.archived[uuid][0]
+        if df is None:
+            raise KeyError(f"unknown dataflow {uuid!r}")
+        loop = asyncio.get_running_loop()
+        futs = []
+        for machine in sorted(df.machines):
+            fut = loop.create_future()
+            self._metrics_waiters[(uuid, machine)] = fut
+            self._daemon_send(machine, cm.MetricsRequest(dataflow_id=uuid))
+            futs.append(fut)
+        try:
+            snapshots = await asyncio.wait_for(
+                asyncio.gather(*futs, return_exceptions=True), timeout=10
+            )
+        finally:
+            for machine in df.machines:
+                self._metrics_waiters.pop((uuid, machine), None)
+        return merge_snapshots([s for s in snapshots if isinstance(s, dict)])
 
     # ------------------------------------------------------------------
     # log streaming
@@ -481,6 +522,22 @@ class Coordinator:
             uuid = self.resolve_name(request.uuid or request.name)
             logs = await self.request_logs(uuid, request.node)
             return cm.LogsReply(logs=logs)
+        if isinstance(request, cm.QueryMetrics):
+            target = request.dataflow_uuid or request.name
+            if target is not None:
+                uuid = self.resolve_name(target)
+            elif len(self.running) == 1:
+                uuid = next(iter(self.running))
+            elif self.running:
+                return cm.Error(
+                    message="multiple dataflows running; pass --uuid or --name"
+                )
+            elif len(self.archived) == 1:
+                uuid = next(iter(self.archived))
+            else:
+                return cm.Error(message="no dataflow running")
+            metrics = await self.request_metrics(uuid)
+            return cm.MetricsReply(dataflow_uuid=uuid, metrics=metrics)
         if isinstance(request, cm.ListDataflows):
             entries = [
                 cm.DataflowListEntry(uuid=u, name=df.name)

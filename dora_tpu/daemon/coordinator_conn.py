@@ -45,6 +45,12 @@ async def run_attached(
     """Register with the coordinator and serve its events until destroyed."""
     daemon.machine_id = machine_id
     await daemon.start()
+    # SIGUSR2 forensics for attached daemons too (run_dataflow_async has
+    # its own) — `dora-tpu up`-spawned daemons are the common wedge case.
+    from dora_tpu.telemetry import install_task_dump, remove_task_dump
+
+    loop = asyncio.get_running_loop()
+    install_task_dump(loop)
     inter_server, inter_port = await inter_daemon.start_server(daemon)
     inter_client = inter_daemon.InterDaemonClient(daemon.clock)
 
@@ -161,6 +167,17 @@ async def run_attached(
                         logs=logs,
                     )
                 )
+            elif isinstance(event, cm.MetricsRequest):
+                df = daemon.dataflows.get(event.dataflow_id)
+                outbox.put_nowait(
+                    cm.MetricsReplyFromDaemon(
+                        dataflow_id=event.dataflow_id,
+                        machine_id=machine_id,
+                        metrics=(
+                            daemon.metrics_snapshot(df) if df is not None else {}
+                        ),
+                    )
+                )
             elif isinstance(event, cm.DestroyDaemon):
                 return
             else:
@@ -170,6 +187,7 @@ async def run_attached(
     finally:
         for t in tasks:
             t.cancel()
+        remove_task_dump(loop)
         inter_client.close()
         inter_server.close()
         try:

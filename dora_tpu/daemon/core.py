@@ -49,6 +49,8 @@ from dora_tpu.message.common import (
     ENCODING_RAW,
 )
 from dora_tpu.message import fastroute
+from dora_tpu.metrics import DataflowMetrics
+from dora_tpu.telemetry import FLIGHT
 from dora_tpu.message.serde import (
     Timestamped,
     decode_timestamped,
@@ -141,6 +143,8 @@ class DataflowState:
     #: edges assigned p2p at barrier release; send_out skips these
     #: (sender, output, receiver, input)
     p2p_edges: set = field(default_factory=set)
+    #: hot-path counters + latency histograms (dora_tpu.metrics)
+    metrics: DataflowMetrics = field(default_factory=DataflowMetrics)
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -158,6 +162,9 @@ class Daemon:
         self.machine_id = machine_id
         self.local_comm = local_comm
         self.uds_dir = uds_dir
+        # Re-read the flight-recorder env knobs: the daemon may be
+        # constructed long after module import (bench A/B legs, tests).
+        FLIGHT.configure_from_env()
         self.clock = HLC()
         self.dataflows: dict[str, DataflowState] = {}
         self._server: asyncio.AbstractServer | None = None
@@ -296,6 +303,7 @@ class Daemon:
                 node_id=nid,
                 queue_sizes=queue_sizes,
                 on_token_unref=lambda token, df=df: self._unref_token(df, token),
+                metrics=df.metrics,
             )
             df.drop_queues[nid] = DropQueue()
             df.control_done[nid] = asyncio.Event()
@@ -490,9 +498,11 @@ class Daemon:
                 if queue is None:
                     continue
                 event = d2n.Input(id=str(target.input), metadata=metadata, data=None)
+                ts = self.clock.new_timestamp()
                 queue.push(
-                    Timestamped(inner=event, timestamp=self.clock.new_timestamp()),
+                    Timestamped(inner=event, timestamp=ts),
                     input_id=str(target.input),
+                    send_ns=ts.physical_ns,
                 )
 
     # ------------------------------------------------------------------
@@ -506,8 +516,13 @@ class Daemon:
         output_id: str,
         metadata: Metadata,
         data: Any,
+        send_ns: int = 0,
     ) -> None:
-        """Route one output to all local receiver queues and remote machines."""
+        """Route one output to all local receiver queues and remote machines.
+
+        ``send_ns`` is the sender's HLC physical timestamp (from the
+        Timestamped frame); it seeds the send→deliver latency histograms.
+        0 means unknown — the routed events fall back to route time."""
         oid = OutputId(NodeId(sender), DataId(output_id))
         token = data.drop_token if isinstance(data, SharedMemoryData) else None
         if oid not in df.open_outputs:
@@ -517,6 +532,10 @@ class Daemon:
         receivers = df.mappings.get(oid, ())
         if token is not None:
             df.tokens[token] = TokenState(owner=sender)
+        nbytes = metadata.type_info.len
+        df.metrics.count_link(sender, output_id, nbytes)
+        if FLIGHT.enabled:
+            FLIGHT.record("route", f"{sender}/{output_id}", nbytes)
 
         remote_machines: set[str] = set()
         for target in receivers:
@@ -533,10 +552,12 @@ class Daemon:
                 event = d2n.Input(
                     id=str(target.input), metadata=metadata, data=data
                 )
+                ts = self.clock.new_timestamp()
                 queue.push(
-                    Timestamped(inner=event, timestamp=self.clock.new_timestamp()),
+                    Timestamped(inner=event, timestamp=ts),
                     input_id=str(target.input),
                     drop_token=token,
+                    send_ns=send_ns or ts.physical_ns,
                 )
             else:
                 remote_machines.add(df.node_machine(rnode))
@@ -572,13 +593,18 @@ class Daemon:
             cached = (
                 oid,
                 [(str(t.node), str(t.input)) for t in df.mappings.get(oid, ())],
+                f"{sender}/{fast.output_id}",  # flight label, built once
             )
             df.route_cache[key] = cached
-        oid, receivers = cached
+        oid, receivers, label = cached
         if oid not in df.open_outputs:
             return True  # dropped, like send_out on a closed output
         if any(rnode not in df.local_nodes for rnode, _ in receivers):
             return False
+        df.metrics.count_link(sender, fast.output_id, fast.payload_len)
+        if FLIGHT.enabled:
+            FLIGHT.record("fastroute_hit", label, fast.payload_len)
+        send_ns = fast.timestamp.physical_ns
         for rnode, input_id in receivers:
             if (sender, fast.output_id, rnode, input_id) in df.p2p_edges:
                 continue  # the sender published this edge peer-to-peer
@@ -591,6 +617,7 @@ class Daemon:
                 wire=fastroute.build_input_event(
                     input_id, fast.body, self.clock.new_timestamp()
                 ),
+                send_ns=send_ns,
             )
         return True
 
@@ -600,6 +627,10 @@ class Daemon:
         """An output forwarded from another machine's daemon."""
         oid = OutputId.parse(output_id)
         data = InlineData(data=payload) if payload is not None else None
+        nbytes = metadata.type_info.len
+        df.metrics.count_link(str(oid.node), str(oid.output), nbytes)
+        if FLIGHT.enabled:
+            FLIGHT.record("route_remote", output_id, nbytes)
         for target in df.mappings.get(oid, ()):  # local receivers only
             rnode = str(target.node)
             if rnode not in df.local_nodes:
@@ -609,10 +640,26 @@ class Daemon:
             if queue is None or str(target.input) not in open_inputs:
                 continue
             event = d2n.Input(id=str(target.input), metadata=metadata, data=data)
+            # Latency measured from local arrival time: remote HLC
+            # physical clocks are not comparable across machines.
+            ts = self.clock.new_timestamp()
             queue.push(
-                Timestamped(inner=event, timestamp=self.clock.new_timestamp()),
+                Timestamped(inner=event, timestamp=ts),
                 input_id=str(target.input),
+                send_ns=ts.physical_ns,
             )
+
+    def metrics_snapshot(self, df: DataflowState) -> dict:
+        """JSON-able metrics snapshot for one dataflow on this machine —
+        the payload of a MetricsRequest reply (daemon → coordinator)."""
+        depths: dict[str, int] = {}
+        for nid, queue in df.queues.items():
+            for input_id, count in queue.input_counts.items():
+                if count:
+                    depths[f"{nid}/{input_id}"] = count
+        snap = df.metrics.snapshot(depths)
+        snap["fastroute"]["fallback_reasons"] = dict(fastroute.FALLBACKS)
+        return snap
 
     def _payload_bytes(self, df: DataflowState, data: Any) -> bytes | None:
         if data is None:
@@ -1012,12 +1059,18 @@ class Daemon:
                 # be causally after the sender's.
                 self.clock.update_with_timestamp(fast.timestamp)
                 if self.send_out_wire(df, node_id, fast):
+                    df.metrics.fastroute_hits += 1
                     continue
                 # Remote receivers: re-decode below (the second clock
                 # update is harmless — HLC updates are monotone).
-            msg = decode_timestamped(frame, self.clock).inner
+            tsd = decode_timestamped(frame, self.clock)
+            msg = tsd.inner
             if isinstance(msg, n2d.SendMessage):
-                self.send_out(df, node_id, msg.output_id, msg.metadata, msg.data)
+                df.metrics.fastroute_fallbacks += 1
+                self.send_out(
+                    df, node_id, msg.output_id, msg.metadata, msg.data,
+                    send_ns=tsd.timestamp.physical_ns,
+                )
             elif isinstance(msg, n2d.ReportDropTokens):
                 self.ack_tokens(df, node_id, msg.drop_tokens)
             elif isinstance(msg, n2d.P2PAnnounce):
@@ -1073,9 +1126,18 @@ class Daemon:
                 self.ack_tokens(df, node_id, msg.drop_tokens)
                 batch = await queue.next_batch()
                 wires = []
+                deliver_ns = time.time_ns()
                 for entry in batch:
                     if entry.drop_token is not None:
                         delivered.add(entry.drop_token)
+                    if entry.send_ns and entry.input_id is not None:
+                        # HLC physical time is time_ns-based, so on one
+                        # machine the difference is real send→deliver
+                        # latency (including queue wait).
+                        df.metrics.observe_latency(
+                            node_id, entry.input_id,
+                            (deliver_ns - entry.send_ns) / 1000.0,
+                        )
                     # Fast-path entries carry their wire image; others
                     # (timers, close events, shmem inputs) encode here.
                     wires.append(

@@ -9,6 +9,7 @@ releases its shared-memory drop token so the sender can reuse the region.
 from __future__ import annotations
 
 import asyncio
+import logging
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -17,6 +18,9 @@ from dora_tpu.core.config import DEFAULT_QUEUE_SIZE
 from dora_tpu.message import daemon_to_node as d2n
 from dora_tpu.message.common import SharedMemoryData
 from dora_tpu.message.serde import Timestamped
+from dora_tpu.telemetry import FLIGHT
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -29,6 +33,9 @@ class QueueEntry:
     #: pre-encoded ``Timestamped(event)`` wire image; the events loop
     #: splices it into the NextEvents reply instead of re-encoding
     wire: bytes | None = None
+    #: sender-side HLC physical ns (send→deliver latency histograms);
+    #: 0 = unknown (close/stop events, which are never measured)
+    send_ns: int = 0
 
 
 @dataclass
@@ -42,9 +49,23 @@ class NodeEventQueue:
     input_counts: dict[str, int] = field(default_factory=dict)
     waiter: asyncio.Future | None = None
     closed: bool = False  # no more events will ever arrive
+    #: DataflowMetrics hook (dora_tpu.metrics); None = unmetered (tests)
+    metrics: Any = None
+    #: input id -> "node/input" flight-recorder label (computed once, so
+    #: the enabled hot path allocates no strings per event)
+    flight_labels: dict[str, str] = field(default_factory=dict)
+
+    def _flight_label(self, input_id: str) -> str:
+        label = self.flight_labels.get(input_id)
+        if label is None:
+            label = self.flight_labels[input_id] = (
+                f"{self.node_id}/{input_id}"
+            )
+        return label
 
     def push(self, event: Timestamped | None, input_id: str | None = None,
-             drop_token: str | None = None, wire: bytes | None = None) -> None:
+             drop_token: str | None = None, wire: bytes | None = None,
+             send_ns: int = 0) -> None:
         if self.closed:
             if drop_token is not None:
                 self.on_token_unref(drop_token)
@@ -55,7 +76,11 @@ class NodeEventQueue:
             if count >= bound:
                 self._drop_oldest(input_id)
             self.input_counts[input_id] = self.input_counts.get(input_id, 0) + 1
-        self.entries.append(QueueEntry(event, input_id, drop_token, wire))
+            if FLIGHT.enabled:
+                FLIGHT.record("enqueue", self._flight_label(input_id),
+                              self.input_counts[input_id])
+        self.entries.append(QueueEntry(event, input_id, drop_token, wire,
+                                       send_ns))
         self._wake()
 
     def _drop_oldest(self, input_id: str) -> None:
@@ -65,6 +90,19 @@ class NodeEventQueue:
                 self.input_counts[input_id] -= 1
                 if entry.drop_token is not None:
                     self.on_token_unref(entry.drop_token)
+                depth = self.input_counts[input_id]
+                # Overflow shedding is a YAML contract, not an error — but
+                # it must never be invisible: the metrics plane counts it
+                # and debug logging names the victim.
+                logger.debug(
+                    "queue overflow: dropped oldest event of %s/%s "
+                    "(depth %d)", self.node_id, input_id, depth,
+                )
+                if FLIGHT.enabled:
+                    FLIGHT.record("drop_oldest",
+                                  self._flight_label(input_id), depth)
+                if self.metrics is not None:
+                    self.metrics.count_drop(self.node_id, input_id)
                 return
 
     def close(self) -> None:

@@ -72,6 +72,16 @@ class ConnectedMachines:
 
 
 @message
+class QueryMetrics:
+    """Fetch the aggregated metrics snapshot of a dataflow (running or
+    finished). With neither uuid nor name, resolves the single running
+    dataflow."""
+
+    dataflow_uuid: str | None = None
+    name: str | None = None
+
+
+@message
 class LogSubscribe:
     """Turn this control connection into a live log stream for a dataflow."""
 
@@ -135,6 +145,12 @@ class DataflowList:
 @message
 class LogsReply:
     logs: bytes
+
+
+@message
+class MetricsReply:
+    dataflow_uuid: str
+    metrics: dict[str, Any]  # merged snapshot (dora_tpu.metrics)
 
 
 @message
@@ -202,6 +218,11 @@ class LogsRequest:
 
 
 @message
+class MetricsRequest:
+    dataflow_id: str
+
+
+@message
 class Heartbeat:
     pass
 
@@ -253,6 +274,13 @@ class LogsReplyFromDaemon:
     dataflow_id: str
     node_id: str
     logs: bytes
+
+
+@message
+class MetricsReplyFromDaemon:
+    dataflow_id: str
+    machine_id: str
+    metrics: dict[str, Any]  # per-machine snapshot (dora_tpu.metrics)
 
 
 @message

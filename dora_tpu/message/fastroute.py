@@ -28,6 +28,18 @@ from __future__ import annotations
 import msgpack
 
 from dora_tpu.clock import Timestamp
+from dora_tpu.telemetry import FLIGHT
+
+#: Process-wide fallback tally by reason — answers "WHY is the fastroute
+#: hit ratio low" (the per-dataflow hit/fallback counters in
+#: dora_tpu.metrics answer "how low"). Exposed in metrics snapshots.
+FALLBACKS: dict[str, int] = {}
+
+
+def _fallback(reason: str) -> None:
+    FALLBACKS[reason] = FALLBACKS.get(reason, 0) + 1
+    FLIGHT.record("fastroute_fallback", reason)
+    return None
 
 
 def _frag(obj) -> bytes:
@@ -65,14 +77,17 @@ def _array_header(n: int) -> bytes:
 class FastSend:
     """A shallow-parsed ``Timestamped(SendMessage)`` frame."""
 
-    __slots__ = ("output_id", "body", "timestamp")
+    __slots__ = ("output_id", "body", "timestamp", "payload_len")
 
-    def __init__(self, output_id: str, body: bytes, timestamp: Timestamp):
+    def __init__(self, output_id: str, body: bytes, timestamp: Timestamp,
+                 payload_len: int = 0):
         self.output_id = output_id
         #: wire bytes spanning ``"metadata": <...>, "data": <...>`` —
         #: exactly the tail an Input event's field map needs
         self.body = body
         self.timestamp = timestamp
+        #: inline payload bytes (metrics: routed bytes per link)
+        self.payload_len = payload_len
 
 
 def parse_send_message(frame) -> FastSend | None:
@@ -87,24 +102,24 @@ def parse_send_message(frame) -> FastSend | None:
         u = msgpack.Unpacker(raw=False, strict_map_key=False)
         u.feed(frame)
         if u.read_map_header() != 2 or u.unpack() != "t":
-            return None
+            return _fallback("envelope")
         if u.unpack() != "Timestamped" or u.unpack() != "f":
-            return None
+            return _fallback("envelope")
         if u.read_map_header() != 2 or u.unpack() != "inner":
-            return None
+            return _fallback("envelope")
         if u.read_map_header() != 2 or u.unpack() != "t":
-            return None
+            return _fallback("envelope")
         if u.unpack() != "SendMessage" or u.unpack() != "f":
-            return None
+            return _fallback("not-send-message")
         if u.read_map_header() != 3 or u.unpack() != "output_id":
-            return None
+            return _fallback("field-order")
         output_id = u.unpack()
         body_start = u.tell()
         if u.unpack() != "metadata":
-            return None
+            return _fallback("field-order")
         u.skip()  # metadata subtree: bytes reused verbatim, never built
         if u.unpack() != "data":
-            return None
+            return _fallback("field-order")
         # The data value must be built (cheap: nil, or one C-level bin
         # copy) to learn its tag — only inline/empty payloads are
         # routable without token bookkeeping.
@@ -112,17 +127,27 @@ def parse_send_message(frame) -> FastSend | None:
         if data is not None and (
             not isinstance(data, dict) or data.get("t") != "InlineData"
         ):
-            return None
+            return _fallback("shmem-data")
         body_end = u.tell()
         if u.unpack() != "timestamp":
-            return None
+            return _fallback("field-order")
         ts = u.unpack()
         if not isinstance(ts, dict) or ts.get("t") != "@ts":
-            return None
+            return _fallback("field-order")
         timestamp = Timestamp.from_wire(ts["f"])
+        payload_len = 0
+        if data is not None:
+            inline = data.get("f")
+            if isinstance(inline, dict):
+                payload = inline.get("data")
+                if payload is not None:
+                    payload_len = len(payload)
     except Exception:
-        return None
-    return FastSend(str(output_id), bytes(frame[body_start:body_end]), timestamp)
+        return _fallback("parse-error")
+    return FastSend(
+        str(output_id), bytes(frame[body_start:body_end]), timestamp,
+        payload_len,
+    )
 
 
 def build_input_event(input_id: str, body: bytes, ts: Timestamp) -> bytes:

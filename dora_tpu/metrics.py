@@ -1,0 +1,195 @@
+"""Dataflow metrics plane: counters + latency histograms, snapshot/merge.
+
+The daemon keeps one :class:`DataflowMetrics` per dataflow and feeds it
+from the routing hot path (``daemon/core.py``), the per-node queues
+(``daemon/queues.py``), and the wire fast path (``message/fastroute.py``):
+
+* per-(sender, output) routed message/byte counters,
+* per-(node, input) drop-oldest counters and live queue depth,
+* fastroute hit/fallback counters (wire-splice vs reflective route),
+* send→deliver latency histograms computed from the HLC timestamps every
+  ``Timestamped`` frame already carries (physical ns, same machine, so
+  the difference is a real wall-clock latency including queue wait).
+
+Everything is plain dicts and ints so the hot-path cost is one dict get
+and one add; ``snapshot()`` produces a JSON-able dict the control plane
+ships daemon → coordinator → CLI, and :func:`merge_snapshots` aggregates
+across machines (histogram bucket counts add; percentiles recompute).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: Histogram buckets are powers of two in microseconds: bucket ``i``
+#: holds values in [2^(i-1), 2^i) µs; bucket 0 holds < 1 µs. 27 buckets
+#: span 1 µs .. ~67 s, which covers everything from a shmem splice to a
+#: wedged queue.
+HISTOGRAM_BUCKETS = 27
+
+
+class Histogram:
+    """Fixed-bucket log2 latency histogram (microseconds)."""
+
+    __slots__ = ("counts", "count", "sum_us")
+
+    def __init__(self):
+        self.counts = [0] * HISTOGRAM_BUCKETS
+        self.count = 0
+        self.sum_us = 0.0
+
+    def observe(self, value_us: float) -> None:
+        if value_us < 0:
+            value_us = 0.0  # HLC logical ticks can run ahead of wall time
+        bucket = min(int(value_us).bit_length(), HISTOGRAM_BUCKETS - 1)
+        self.counts[bucket] += 1
+        self.count += 1
+        self.sum_us += value_us
+
+    def snapshot(self) -> dict:
+        out = {
+            "count": self.count,
+            "sum_us": round(self.sum_us, 1),
+            "counts": list(self.counts),
+        }
+        for p in (50, 90, 99):
+            out[f"p{p}_us"] = percentile_from_counts(self.counts, p)
+        return out
+
+
+def bucket_upper_us(i: int) -> float:
+    """Upper bound of bucket ``i`` in µs (reported percentile value)."""
+    return float(1 << i)
+
+
+def percentile_from_counts(counts: list[int], p: float) -> float | None:
+    """The p-th percentile latency from histogram bucket counts — the
+    upper bound of the bucket the rank falls in (pessimistic by at most
+    one octave, which is the histogram's stated resolution)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = total * p / 100.0
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            return bucket_upper_us(i)
+    return bucket_upper_us(len(counts) - 1)
+
+
+class DataflowMetrics:
+    """Hot-path counters for one dataflow (daemon side)."""
+
+    __slots__ = (
+        "links",
+        "drops",
+        "latency",
+        "fastroute_hits",
+        "fastroute_fallbacks",
+    )
+
+    def __init__(self):
+        #: (sender, output) -> [msgs, bytes]
+        self.links: dict[tuple[str, str], list] = {}
+        #: (node, input) -> dropped-oldest count
+        self.drops: dict[tuple[str, str], int] = {}
+        #: (node, input) -> send→deliver Histogram
+        self.latency: dict[tuple[str, str], Histogram] = {}
+        self.fastroute_hits = 0
+        self.fastroute_fallbacks = 0
+
+    # -- hot-path feeders ---------------------------------------------------
+
+    def count_link(self, sender: str, output: str, nbytes: int) -> None:
+        entry = self.links.get((sender, output))
+        if entry is None:
+            entry = self.links[(sender, output)] = [0, 0]
+        entry[0] += 1
+        entry[1] += nbytes
+
+    def count_drop(self, node: str, input_id: str) -> None:
+        key = (node, input_id)
+        self.drops[key] = self.drops.get(key, 0) + 1
+
+    def observe_latency(self, node: str, input_id: str, us: float) -> None:
+        hist = self.latency.get((node, input_id))
+        if hist is None:
+            hist = self.latency[(node, input_id)] = Histogram()
+        hist.observe(us)
+
+    # -- export -------------------------------------------------------------
+
+    def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
+        hits, falls = self.fastroute_hits, self.fastroute_fallbacks
+        routed = hits + falls
+        return {
+            "links": {
+                f"{s}/{o}": {"msgs": v[0], "bytes": v[1]}
+                for (s, o), v in self.links.items()
+            },
+            "drops": {f"{n}/{i}": c for (n, i), c in self.drops.items()},
+            "queue_depth": dict(queue_depths or {}),
+            "fastroute": {
+                "hits": hits,
+                "fallbacks": falls,
+                "hit_ratio": round(hits / routed, 4) if routed else None,
+            },
+            "latency_us": {
+                f"{n}/{i}": h.snapshot() for (n, i), h in self.latency.items()
+            },
+        }
+
+
+def merge_snapshots(snapshots: list[dict]) -> dict:
+    """Aggregate per-daemon snapshots into one cluster view (coordinator).
+
+    Counters add; queue depths union (each input queue lives on exactly
+    one machine); histogram bucket counts add and percentiles recompute
+    from the merged buckets."""
+    links: dict[str, dict] = {}
+    drops: dict[str, int] = {}
+    depth: dict[str, int] = {}
+    hits = falls = 0
+    lat_counts: dict[str, list[int]] = {}
+    lat_sum: dict[str, float] = {}
+    for snap in snapshots:
+        if not snap:
+            continue
+        for key, v in snap.get("links", {}).items():
+            entry = links.setdefault(key, {"msgs": 0, "bytes": 0})
+            entry["msgs"] += v.get("msgs", 0)
+            entry["bytes"] += v.get("bytes", 0)
+        for key, c in snap.get("drops", {}).items():
+            drops[key] = drops.get(key, 0) + c
+        depth.update(snap.get("queue_depth", {}))
+        fr = snap.get("fastroute", {})
+        hits += fr.get("hits", 0)
+        falls += fr.get("fallbacks", 0)
+        for key, h in snap.get("latency_us", {}).items():
+            counts = lat_counts.setdefault(key, [0] * HISTOGRAM_BUCKETS)
+            for i, c in enumerate(h.get("counts", [])[:HISTOGRAM_BUCKETS]):
+                counts[i] += c
+            lat_sum[key] = lat_sum.get(key, 0.0) + h.get("sum_us", 0.0)
+    routed = hits + falls
+    latency = {}
+    for key, counts in lat_counts.items():
+        entry = {
+            "count": sum(counts),
+            "sum_us": round(lat_sum[key], 1),
+            "counts": counts,
+        }
+        for p in (50, 90, 99):
+            entry[f"p{p}_us"] = percentile_from_counts(counts, p)
+        latency[key] = entry
+    return {
+        "links": links,
+        "drops": drops,
+        "queue_depth": depth,
+        "fastroute": {
+            "hits": hits,
+            "fallbacks": falls,
+            "hit_ratio": round(hits / routed, 4) if routed else None,
+        },
+        "latency_us": latency,
+    }

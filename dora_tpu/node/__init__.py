@@ -95,9 +95,20 @@ class Node:
     """One dataflow node (spawned by the daemon, or dynamic)."""
 
     def __init__(self, node_id: str | None = None, daemon_addr: str | None = None):
-        from dora_tpu.telemetry import install_stack_dump
+        from dora_tpu.telemetry import (
+            FLIGHT,
+            install_flight_dump,
+            install_stack_dump,
+        )
 
         install_stack_dump()
+        FLIGHT.configure_from_env()
+        if FLIGHT.enabled:
+            install_flight_dump()
+        self._flight = FLIGHT
+        #: per-output published message/byte counters (node-local view;
+        #: the daemon's metrics plane is authoritative for routed counts)
+        self._send_counts: dict[str, list] = {}
         config = self._load_config(node_id, daemon_addr)
         self._config = config
         self.dataflow_id = config.dataflow_id
@@ -304,6 +315,14 @@ class Node:
         """Route one output: peer-to-peer edges first (direct shmem
         exchange, ~32 µs), then the daemon SendMessage only when some
         receiver still needs it (non-p2p local, remote, or none)."""
+        nbytes = metadata.type_info.len
+        counts = self._send_counts.get(output_id)
+        if counts is None:
+            counts = self._send_counts[output_id] = [0, 0]
+        counts[0] += 1
+        counts[1] += nbytes
+        if self._flight.enabled:
+            self._flight.record("send", output_id, nbytes)
         if self._p2p is not None:
             if not self._p2p.publish(output_id, metadata, data):
                 return

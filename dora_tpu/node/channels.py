@@ -16,6 +16,7 @@ from dora_tpu.message import daemon_to_node as d2n
 from dora_tpu.message import node_to_daemon as n2d
 from dora_tpu.message.serde import decode_timestamped, encode_timestamped
 from dora_tpu.native import Disconnected, ShmemChannel
+from dora_tpu.telemetry import FLIGHT
 from dora_tpu.transport.framing import recv_frame, send_frame, send_frames
 
 
@@ -140,7 +141,9 @@ class DaemonChannel:
     def _flush_locked(self) -> None:
         if self._pending:
             pending, self._pending = self._pending, []
-            self._pending_bytes = 0
+            nbytes, self._pending_bytes = self._pending_bytes, 0
+            if FLIGHT.enabled:
+                FLIGHT.record("coalesce_flush", len(pending), nbytes)
             self._transport.send_many(pending)
 
     def request(self, msg: Any) -> Any:

@@ -21,6 +21,138 @@ OTEL_CTX_KEY = "open_telemetry_context"
 logger = logging.getLogger(__name__)
 
 
+def otlp_endpoint() -> str | None:
+    """Single resolution rule for the OTLP export endpoint, shared by
+    tracing and metrics: ``OTEL_EXPORTER_OTLP_ENDPOINT`` wins, with
+    ``DORA_JAEGER_TRACING`` (the reference's legacy spelling) as the
+    fallback. Both exporters MUST use this helper so setting either
+    variable lights up the whole telemetry export path."""
+    return (
+        os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        or os.environ.get("DORA_JAEGER_TRACING")
+        or None
+    )
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (hot-path forensics)
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Fixed-size, allocation-free ring of timestamped hot-path events.
+
+    The message plane records route / enqueue / drop-oldest / coalesce
+    flush / fastroute hit-or-fallback events here when enabled
+    (``DORA_FLIGHT_RECORDER=1``; size via ``DORA_FLIGHT_RECORDER_SIZE``,
+    default 4096). Slots are preallocated lists mutated in place, so the
+    steady state allocates nothing; when disabled, :meth:`record` is a
+    single attribute check and return, so the hot path pays ~0.
+
+    Recording from several threads may interleave slot writes; the ring
+    is a forensic tool, not an exact log, and an occasionally torn slot
+    is an accepted trade for staying lock-free on the hot path. The ring
+    is dumped on SIGUSR2 alongside the asyncio task dump (daemons) or
+    via :func:`install_flight_dump` (nodes).
+    """
+
+    __slots__ = ("enabled", "_slots", "_size", "_idx")
+
+    def __init__(self, size: int = 4096, enabled: bool = False):
+        self._size = max(1, size)
+        self._slots = [[0, "", None, None] for _ in range(self._size)]
+        self._idx = 0
+        self.enabled = enabled
+
+    def configure_from_env(self) -> None:
+        """Re-read the env knobs (daemons/nodes call this at startup, so
+        a knob set after module import — e.g. a bench A/B leg — still
+        takes effect in-process)."""
+        self.enabled = os.environ.get("DORA_FLIGHT_RECORDER", "") not in ("", "0")
+        size = int(os.environ.get("DORA_FLIGHT_RECORDER_SIZE", "0") or "0")
+        if size > 0 and size != self._size:
+            self._size = size
+            self._slots = [[0, "", None, None] for _ in range(size)]
+            self._idx = 0
+
+    def record(self, kind: str, a=None, b=None) -> None:
+        if not self.enabled:
+            return
+        slot = self._slots[self._idx % self._size]
+        slot[0] = time.monotonic_ns()
+        slot[1] = kind
+        slot[2] = a
+        slot[3] = b
+        self._idx += 1
+
+    def events(self) -> list[tuple]:
+        """Recorded events, oldest first (filled slots only)."""
+        n = min(self._idx, self._size)
+        start = self._idx - n
+        out = []
+        for i in range(start, self._idx):
+            t, kind, a, b = self._slots[i % self._size]
+            out.append((t, kind, a, b))
+        return out
+
+    def clear(self) -> None:
+        self._idx = 0
+        for slot in self._slots:
+            slot[0] = 0
+            slot[1] = ""
+            slot[2] = None
+            slot[3] = None
+
+    def dump(self, file=None) -> None:
+        import sys
+
+        file = file or sys.stderr
+        events = self.events()
+        print(
+            f"--- flight recorder ({len(events)} events, "
+            f"{self._idx} recorded total)",
+            file=file,
+        )
+        for t, kind, a, b in events:
+            extra = " ".join(str(x) for x in (a, b) if x is not None)
+            print(f"  {t} {kind} {extra}".rstrip(), file=file)
+        file.flush()
+
+
+#: Process-wide recorder; env-configured at import, re-read by
+#: Daemon()/Node() via configure_from_env so late env changes count.
+FLIGHT = FlightRecorder(
+    size=int(os.environ.get("DORA_FLIGHT_RECORDER_SIZE", "4096") or "4096"),
+    enabled=os.environ.get("DORA_FLIGHT_RECORDER", "") not in ("", "0"),
+)
+
+
+def install_flight_dump() -> None:
+    """`kill -USR2 <pid>` dumps the flight-recorder ring to stderr — the
+    node-process counterpart of the daemon's task dump (nodes are
+    synchronous; there is no asyncio loop to hang a handler on). Chains
+    any pre-existing SIGUSR2 handler; no-op off the main thread or when
+    DORA_NO_STACK_DUMP=1."""
+    if os.environ.get("DORA_NO_STACK_DUMP"):
+        return
+    import signal
+
+    try:
+        previous = signal.getsignal(signal.SIGUSR2)
+
+        def _handler(signum, frame):
+            FLIGHT.dump()
+            if callable(previous) and previous not in (
+                signal.SIG_IGN,
+                signal.SIG_DFL,
+            ):
+                previous(signum, frame)
+
+        signal.signal(signal.SIGUSR2, _handler)
+    except (ValueError, AttributeError, OSError):
+        pass  # not the main thread / no SIGUSR2 on this platform
+
+
 def install_stack_dump() -> None:
     """`kill -USR1 <pid>` dumps all Python stacks to stderr (the
     daemon-side log file) — a wedged node in a stuck dataflow can always
@@ -60,6 +192,7 @@ def install_task_dump(loop) -> None:
             print(f"task {task.get_name()}: {task}", file=sys.stderr)
             for frame in task.get_stack():
                 traceback.print_stack(frame, limit=1, file=sys.stderr)
+        FLIGHT.dump(sys.stderr)
         sys.stderr.flush()
 
     try:
@@ -126,9 +259,7 @@ def set_up_tracing(name: str):
         format=f"%(asctime)s {name} %(levelname)s %(name)s: %(message)s",
     )
     global _tracer
-    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT") or os.environ.get(
-        "DORA_JAEGER_TRACING"
-    )
+    endpoint = otlp_endpoint()
     if not endpoint:
         return None
     try:
@@ -212,6 +343,11 @@ class MetricsSampler:
             import psutil
 
             self._proc = psutil.Process()
+            # Prime cpu_percent: psutil computes it from the delta since
+            # the previous call, so the first interval=None reading is
+            # garbage (0.0). Paying the baseline read here makes the
+            # first sample() meaningful.
+            self._proc.cpu_percent(interval=None)
         except Exception:
             self._proc = None
 
@@ -249,7 +385,7 @@ def init_metrics(name: str, interval_s: float = 10.0) -> MetricsSampler:
     """System-metrics handle; wires periodic OTLP export when the otel SDK
     and an endpoint are both present, mirroring ``set_up_tracing``."""
     sampler = MetricsSampler(name)
-    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    endpoint = otlp_endpoint()  # same resolution as set_up_tracing
     if not endpoint:
         return sampler
     try:

@@ -1,0 +1,350 @@
+"""Metrics plane: histogram math, snapshot/merge, flight recorder ring,
+drop-oldest observability, and the end-to-end QueryMetrics path
+(daemon feeds counters -> coordinator aggregates -> CLI renders)."""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+
+import pytest
+import yaml
+
+from dora_tpu.coordinator import Coordinator
+from dora_tpu.daemon.core import Daemon
+from dora_tpu.daemon.queues import NodeEventQueue
+from dora_tpu.message import coordinator as cm
+from dora_tpu.metrics import (
+    HISTOGRAM_BUCKETS,
+    DataflowMetrics,
+    Histogram,
+    merge_snapshots,
+    percentile_from_counts,
+)
+from dora_tpu.telemetry import FlightRecorder
+
+
+# ---------------------------------------------------------------------------
+# histogram units
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_placement():
+    h = Histogram()
+    h.observe(0.5)  # sub-µs -> bucket 0
+    h.observe(1.0)  # bucket 1 (bit_length(1) == 1)
+    h.observe(100.0)  # bucket 7 (64..128 µs)
+    h.observe(1e12)  # clamps into the last bucket
+    h.observe(-5.0)  # HLC skew clamps to 0
+    assert h.count == 5
+    assert h.counts[0] == 2  # 0.5 and the clamped negative
+    assert h.counts[1] == 1
+    assert h.counts[7] == 1
+    assert h.counts[HISTOGRAM_BUCKETS - 1] == 1
+
+
+def test_histogram_percentiles_are_bucket_upper_bounds():
+    h = Histogram()
+    for _ in range(99):
+        h.observe(100.0)  # bucket 7, upper bound 128 µs
+    h.observe(5000.0)  # bucket 13, upper bound 8192 µs
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["p50_us"] == 128.0
+    assert snap["p90_us"] == 128.0
+    assert snap["p99_us"] == 128.0
+    assert percentile_from_counts(h.counts, 100) == 8192.0
+
+
+def test_percentile_of_empty_is_none():
+    assert percentile_from_counts([0] * HISTOGRAM_BUCKETS, 50) is None
+    assert Histogram().snapshot()["p50_us"] is None
+
+
+# ---------------------------------------------------------------------------
+# DataflowMetrics snapshot + cross-machine merge
+# ---------------------------------------------------------------------------
+
+
+def _machine_a() -> dict:
+    m = DataflowMetrics()
+    m.count_link("src", "out", 1024)
+    m.count_link("src", "out", 1024)
+    m.count_drop("sink", "in")
+    m.observe_latency("sink", "in", 100.0)
+    m.fastroute_hits = 3
+    m.fastroute_fallbacks = 1
+    return m.snapshot({"sink/in": 2})
+
+
+def _machine_b() -> dict:
+    m = DataflowMetrics()
+    m.count_link("src", "out", 512)
+    m.count_link("relay", "fwd", 256)
+    m.observe_latency("sink", "in", 5000.0)
+    m.fastroute_hits = 1
+    return m.snapshot({"relay/data": 1})
+
+
+def test_snapshot_shape():
+    snap = _machine_a()
+    assert snap["links"]["src/out"] == {"msgs": 2, "bytes": 2048}
+    assert snap["drops"]["sink/in"] == 1
+    assert snap["queue_depth"]["sink/in"] == 2
+    assert snap["fastroute"]["hit_ratio"] == 0.75
+    assert snap["latency_us"]["sink/in"]["count"] == 1
+
+
+def test_merge_adds_counters_and_recomputes_percentiles():
+    merged = merge_snapshots([_machine_a(), _machine_b()])
+    assert merged["links"]["src/out"] == {"msgs": 3, "bytes": 2560}
+    assert merged["links"]["relay/fwd"] == {"msgs": 1, "bytes": 256}
+    assert merged["drops"] == {"sink/in": 1}
+    # Depth unions: each input queue lives on exactly one machine.
+    assert merged["queue_depth"] == {"sink/in": 2, "relay/data": 1}
+    assert merged["fastroute"]["hits"] == 4
+    assert merged["fastroute"]["hit_ratio"] == 0.8
+    lat = merged["latency_us"]["sink/in"]
+    assert lat["count"] == 2
+    assert lat["p50_us"] == 128.0  # 100 µs observation's bucket
+    assert lat["p99_us"] == 8192.0  # 5000 µs observation's bucket
+
+
+def test_merge_of_nothing():
+    merged = merge_snapshots([])
+    assert merged["links"] == {}
+    assert merged["fastroute"]["hit_ratio"] is None
+    assert merge_snapshots([{}, None])["latency_us"] == {}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_disabled_is_a_noop():
+    r = FlightRecorder(size=8, enabled=False)
+    r.record("route", "a/b", 1)
+    assert r.events() == []
+
+
+def test_flight_recorder_ring_wraps_oldest_first():
+    r = FlightRecorder(size=4, enabled=True)
+    for i in range(10):
+        r.record("route", "x", i)
+    events = r.events()
+    assert len(events) == 4
+    assert [e[3] for e in events] == [6, 7, 8, 9]
+    stamps = [e[0] for e in events]
+    assert stamps == sorted(stamps)
+    assert all(e[1] == "route" for e in events)
+
+
+def test_flight_recorder_dump_and_clear():
+    r = FlightRecorder(size=8, enabled=True)
+    r.record("drop_oldest", "sink/in", 3)
+    buf = io.StringIO()
+    r.dump(buf)
+    out = buf.getvalue()
+    assert "flight recorder (1 events" in out
+    assert "drop_oldest sink/in 3" in out
+    r.clear()
+    assert r.events() == []
+
+
+def test_flight_recorder_env_reconfigure(monkeypatch):
+    r = FlightRecorder(size=8, enabled=False)
+    monkeypatch.setenv("DORA_FLIGHT_RECORDER", "1")
+    monkeypatch.setenv("DORA_FLIGHT_RECORDER_SIZE", "16")
+    r.configure_from_env()
+    assert r.enabled and r._size == 16
+    monkeypatch.setenv("DORA_FLIGHT_RECORDER", "0")
+    r.configure_from_env()
+    assert not r.enabled
+
+
+# ---------------------------------------------------------------------------
+# drop-oldest observability (satellite regression test)
+# ---------------------------------------------------------------------------
+
+
+def test_drop_oldest_feeds_counter_and_debug_log(caplog):
+    metrics = DataflowMetrics()
+    q = NodeEventQueue(
+        node_id="sink",
+        queue_sizes={"in": 2},
+        on_token_unref=lambda token: None,
+        metrics=metrics,
+    )
+    with caplog.at_level(logging.DEBUG, logger="dora_tpu.daemon.queues"):
+        for _ in range(5):
+            q.push(None, input_id="in")
+    assert q.input_counts["in"] == 2  # bound held
+    assert metrics.drops[("sink", "in")] == 3
+    assert "queue overflow: dropped oldest event of sink/in" in caplog.text
+
+
+# ---------------------------------------------------------------------------
+# end to end: daemon counters -> coordinator aggregation -> CLI table
+# ---------------------------------------------------------------------------
+
+
+COUNT = 5
+
+
+def chain_spec() -> dict:
+    data = str(list(range(COUNT)))
+    return {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": data, "COUNT": str(COUNT)},
+            },
+            {
+                "id": "receiver",
+                "path": "module:dora_tpu.nodehub.pyarrow_assert",
+                "inputs": {"in": "sender/data"},
+                "env": {"DATA": data, "MIN_COUNT": str(COUNT)},
+            },
+        ]
+    }
+
+
+async def _wait_machines(coord, expected, timeout: float = 10):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await coord.handle_control_request(cm.ConnectedMachines())
+        if set(reply.machines) >= expected:
+            return
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"machines {expected} never registered")
+        await asyncio.sleep(0.05)
+
+
+async def _wait_finished(coord, uuid, timeout: float = 60):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await coord.handle_control_request(cm.Check(dataflow_uuid=uuid))
+        if isinstance(reply, cm.DataflowStopped):
+            return reply.result
+        if isinstance(reply, cm.Error):
+            raise AssertionError(reply.message)
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError("dataflow never finished")
+        await asyncio.sleep(0.1)
+
+
+def test_query_metrics_end_to_end(tmp_path, monkeypatch, capsys):
+    # P2P edges bypass the daemon entirely; force the daemon route so the
+    # metrics plane sees the traffic.
+    monkeypatch.setenv("DORA_P2P", "0")
+
+    cli_out: dict = {}
+
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        daemon = Daemon()
+        task = asyncio.create_task(
+            daemon.run(f"127.0.0.1:{coord.daemon_port}", "A")
+        )
+        try:
+            await _wait_machines(coord, {"A"})
+            start = await coord.handle_control_request(
+                cm.Start(
+                    dataflow=chain_spec(),
+                    name="metered",
+                    local_working_dir=str(tmp_path),
+                )
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+            result = await _wait_finished(coord, start.uuid)
+            assert result.is_ok(), result.errors()
+
+            # Finished dataflows stay queryable (daemon keeps the state).
+            reply = await coord.handle_control_request(
+                cm.QueryMetrics(dataflow_uuid=start.uuid)
+            )
+            assert isinstance(reply, cm.MetricsReply), reply
+            m = reply.metrics
+            link = m["links"]["sender/data"]
+            assert link["msgs"] >= COUNT
+            assert link["bytes"] > 0
+            lat = m["latency_us"]["receiver/in"]
+            assert lat["count"] >= COUNT
+            assert lat["p50_us"] is not None
+            assert lat["p99_us"] >= lat["p50_us"]
+            fr = m["fastroute"]
+            assert fr["hits"] > 0
+            assert fr["hit_ratio"] > 0
+
+            # Neither uuid nor name: the single (archived) dataflow.
+            by_default = await coord.handle_control_request(cm.QueryMetrics())
+            assert isinstance(by_default, cm.MetricsReply)
+            assert by_default.dataflow_uuid == start.uuid
+
+            # By name, after completion: archived names stay resolvable.
+            by_name = await coord.handle_control_request(
+                cm.QueryMetrics(name="metered")
+            )
+            assert isinstance(by_name, cm.MetricsReply), by_name
+            assert by_name.dataflow_uuid == start.uuid
+
+            # The CLI renders the same snapshot over the real control port.
+            from dora_tpu.cli.main import main as cli_main
+
+            addr = f"127.0.0.1:{coord.control_port}"
+            cli_out["rc"] = await asyncio.to_thread(
+                cli_main,
+                ["metrics", "--uuid", start.uuid, "--coordinator-addr", addr],
+            )
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            task.cancel()
+            await coord.close()
+
+    asyncio.run(main())
+    assert cli_out["rc"] == 0
+    out = capsys.readouterr().out
+    assert "sender/data" in out
+    assert "fastroute" in out
+    assert "receiver/in" in out
+
+
+def test_query_metrics_unknown_dataflow():
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        try:
+            reply = await coord.handle_control_request(
+                cm.QueryMetrics(dataflow_uuid="no-such-uuid")
+            )
+            assert isinstance(reply, cm.Error)
+            empty = await coord.handle_control_request(cm.QueryMetrics())
+            assert isinstance(empty, cm.Error)
+            assert "no dataflow" in empty.message
+        finally:
+            await coord.close()
+
+    asyncio.run(main())
+
+
+def test_metrics_view_renders_rates():
+    from dora_tpu.cli.metrics_view import render_metrics
+
+    prev = _machine_a()
+    snap = merge_snapshots([prev, _machine_b()])
+    text = render_metrics("uuid-1", snap, prev=prev, interval=2.0)
+    assert "fastroute 80.0%" in text
+    assert "src/out" in text
+    # Rate column: (3 - 2) msgs over 2 s.
+    assert "0.5" in text
+    assert "MSG/S" in text
+    # Without watch state there are no rate columns.
+    plain = render_metrics("uuid-1", snap)
+    assert "MSG/S" not in plain
+    empty = render_metrics("uuid-2", {})
+    assert "no routed links" in empty

@@ -1,0 +1,134 @@
+"""Telemetry: span-fallback traceparent chains, context codec round-trips,
+MetricsSampler cpu_percent priming, OTLP endpoint resolution, and the
+SIGUSR2 flight-recorder dump hook."""
+
+from __future__ import annotations
+
+import os
+import signal
+
+import pytest
+
+import dora_tpu.telemetry as tel
+
+
+# ---------------------------------------------------------------------------
+# span fallback (no OTel SDK configured)
+# ---------------------------------------------------------------------------
+
+
+def _traceparent(ctx: str) -> str:
+    tp = tel.parse_otel_context(ctx).get("traceparent")
+    assert tp is not None, ctx
+    version, trace_id, span_id, flags = tp.split("-")
+    assert version == "00" and flags == "01"
+    assert len(trace_id) == 32 and len(span_id) == 16
+    return tp
+
+
+def test_span_fallback_chain_is_coherent_across_three_hops(monkeypatch):
+    monkeypatch.setenv("DORA_TRACING", "1")
+    assert tel._tracer is None  # fallback path, not the SDK
+    with tel.span("hop-1") as ctx1:
+        with tel.span("hop-2", ctx1) as ctx2:
+            with tel.span("hop-3", ctx2) as ctx3:
+                pass
+    tps = [_traceparent(c) for c in (ctx1, ctx2, ctx3)]
+    trace_ids = {tp.split("-")[1] for tp in tps}
+    span_ids = {tp.split("-")[2] for tp in tps}
+    assert len(trace_ids) == 1  # one trace end to end
+    assert len(span_ids) == 3  # one fresh span per hop
+
+
+def test_span_disabled_forwards_parent_unchanged(monkeypatch):
+    monkeypatch.delenv("DORA_TRACING", raising=False)
+    with tel.span("anything", "traceparent:00-aa-bb-01;") as ctx:
+        assert ctx == "traceparent:00-aa-bb-01;"
+
+
+def test_span_fallback_tolerates_malformed_parent(monkeypatch):
+    monkeypatch.setenv("DORA_TRACING", "1")
+    with tel.span("hop", "traceparent:garbage;") as ctx:
+        _traceparent(ctx)  # fresh, well-formed ids
+
+
+# ---------------------------------------------------------------------------
+# context codec
+# ---------------------------------------------------------------------------
+
+
+def test_context_round_trip_with_colons_in_values():
+    ctx = {
+        "traceparent": "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+        "tracestate": "vendor=a:b:c",
+    }
+    assert tel.parse_otel_context(tel.serialize_context(ctx)) == ctx
+
+
+def test_inject_and_extract_context():
+    metadata: dict = {}
+    tel.inject_context(metadata, {"traceparent": "00-a-b-01"})
+    assert tel.extract_context(metadata) == {"traceparent": "00-a-b-01"}
+    # Empty context attaches nothing.
+    assert tel.OTEL_CTX_KEY not in tel.inject_context({}, "")
+
+
+# ---------------------------------------------------------------------------
+# MetricsSampler priming (satellite regression test)
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_primes_cpu_percent_in_init(monkeypatch):
+    psutil = pytest.importorskip("psutil")
+    calls: list = []
+
+    def counting(self, interval=None):
+        calls.append(interval)
+        return 12.5
+
+    monkeypatch.setattr(psutil.Process, "cpu_percent", counting)
+    sampler = tel.MetricsSampler("test")
+    # The baseline read happens at construction, so the FIRST sample()
+    # already returns a meaningful delta (the pre-fix first read is 0.0).
+    assert calls == [None]
+    out = sampler.sample()
+    assert calls == [None, None]
+    assert out["cpu_percent"] == 12.5
+
+
+# ---------------------------------------------------------------------------
+# OTLP endpoint resolution (shared by tracing and metrics export)
+# ---------------------------------------------------------------------------
+
+
+def test_otlp_endpoint_precedence(monkeypatch):
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    monkeypatch.delenv("DORA_JAEGER_TRACING", raising=False)
+    assert tel.otlp_endpoint() is None
+    monkeypatch.setenv("DORA_JAEGER_TRACING", "http://jaeger:4317")
+    assert tel.otlp_endpoint() == "http://jaeger:4317"
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://otel:4317")
+    assert tel.otlp_endpoint() == "http://otel:4317"
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR2 flight-recorder dump (sync-node hook)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR2"), reason="no SIGUSR2")
+def test_install_flight_dump_on_sigusr2(capsys):
+    previous = signal.getsignal(signal.SIGUSR2)
+    try:
+        tel.FLIGHT.enabled = True
+        tel.FLIGHT.clear()
+        tel.FLIGHT.record("route", "a/out", 64)
+        tel.install_flight_dump()
+        os.kill(os.getpid(), signal.SIGUSR2)
+        err = capsys.readouterr().err
+        assert "flight recorder" in err
+        assert "route a/out 64" in err
+    finally:
+        tel.FLIGHT.enabled = False
+        tel.FLIGHT.clear()
+        signal.signal(signal.SIGUSR2, previous)
