@@ -394,6 +394,40 @@ def recorder_ab_leg() -> dict:
     }
 
 
+def tracing_ab_leg() -> dict:
+    """Trace-plane A/B on the daemon route: off vs DORA_TRACING=1, runs
+    interleaved so both sides see the same machine conditions. Tracing-on
+    pays per-message span records end to end (node t_send, daemon
+    t_route/t_deliver, receiver t_recv, ring shipping); tracing-off must
+    stay within the ≤3% msgs_per_sec budget (single attribute checks)."""
+    off: list[float] = []
+    on: list[float] = []
+    for i in range(SMALL_RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-trc-") as tmp:
+            off.append(small_message_run(Path(tmp), "daemon")["msgs_per_sec"])
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-trc-") as tmp:
+            on.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={"DORA_TRACING": "1"},
+                )["msgs_per_sec"]
+            )
+        print(
+            f"# tracing A/B run {i + 1}/{SMALL_RUNS}: "
+            f"off {off[-1]:.0f} msg/s, on {on[-1]:.0f} msg/s",
+            file=sys.stderr,
+        )
+    off_m = statistics.median(off)
+    on_m = statistics.median(on)
+    return {
+        "off_msgs_per_sec": round(off_m, 0),
+        "on_msgs_per_sec": round(on_m, 0),
+        "overhead_pct": (
+            round((off_m - on_m) / off_m * 100, 2) if off_m else None
+        ),
+    }
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -528,6 +562,16 @@ def main() -> int:
         }
 
     try:
+        tracing_ab = tracing_ab_leg()
+    except Exception as exc:
+        tracing_ab = {
+            "off_msgs_per_sec": None,
+            "on_msgs_per_sec": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -558,6 +602,7 @@ def main() -> int:
         "p99_us_1kib": {route: small[route]["p99_us"] for route in small},
         "small_msg_detail": small,
         "recorder_ab": recorder_ab,
+        "tracing_ab": tracing_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

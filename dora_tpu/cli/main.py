@@ -391,6 +391,42 @@ def cmd_metrics(args) -> int:
             time.sleep(args.interval)
 
 
+def cmd_trace(args) -> int:
+    """Export a dataflow's merged, clock-aligned message timeline as
+    Chrome trace JSON (load in Perfetto / chrome://tracing). ``--check``
+    runs the offline exporter schema self-check instead."""
+    import json
+
+    from dora_tpu.tracing import self_check, to_chrome_trace, validate_chrome_trace
+
+    if args.check:
+        problems = self_check()
+        for problem in problems:
+            print(problem, file=sys.stderr)
+        if problems:
+            return 1
+        print("trace export schema: OK")
+        return 0
+    with _control(args) as c:
+        reply = c.request(cm.QueryTrace(dataflow_uuid=args.uuid, name=args.name))
+        if isinstance(reply, cm.Error):
+            print(reply.message, file=sys.stderr)
+            return 1
+        trace = to_chrome_trace(reply.trace)
+        for problem in validate_chrome_trace(trace):
+            print(f"warning: {problem}", file=sys.stderr)
+        text = json.dumps(trace)
+        if args.out:
+            Path(args.out).write_text(text)
+            print(
+                f"wrote {args.out} ({len(trace['traceEvents'])} events) — "
+                "load in Perfetto (ui.perfetto.dev) or chrome://tracing"
+            )
+        else:
+            print(text)
+    return 0
+
+
 def cmd_logs(args) -> int:
     with _control(args) as c:
         reply = c.request(cm.Logs(uuid=args.uuid, name=args.name, node=args.node))
@@ -533,6 +569,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     coordinator_addr(p)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "trace",
+        help="export a dataflow's message timeline (Chrome trace / Perfetto)",
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument(
+        "--out", default=None, help="write the JSON here (default: stdout)"
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="offline schema self-check of the trace exporter (no cluster)",
+    )
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("logs", help="print a node's logs")
     p.add_argument("node")

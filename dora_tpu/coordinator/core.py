@@ -87,6 +87,7 @@ class Coordinator:
         self._log_waiters: dict[tuple[str, str], asyncio.Future] = {}
         #: correlation for metrics requests: (dataflow_id, machine) -> future
         self._metrics_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        self._trace_waiters: dict[tuple[str, str], asyncio.Future] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -206,6 +207,10 @@ class Coordinator:
             fut = self._metrics_waiters.get((event.dataflow_id, event.machine_id))
             if fut is not None and not fut.done():
                 fut.set_result(event.metrics)
+        elif isinstance(event, cm.TraceReplyFromDaemon):
+            fut = self._trace_waiters.get((event.dataflow_id, event.machine_id))
+            if fut is not None and not fut.done():
+                fut.set_result(event.trace)
         else:
             logger.warning("unexpected daemon event %s", type(event).__name__)
 
@@ -333,6 +338,23 @@ class Coordinator:
             )
         return fut
 
+    def _query_target(self, dataflow_uuid: str | None, name: str | None):
+        """Shared target resolution for QueryMetrics/QueryTrace: explicit
+        uuid/name wins; otherwise the single running dataflow, else the
+        single archived one. Returns a uuid or a ready-to-send Error."""
+        target = dataflow_uuid or name
+        if target is not None:
+            return self.resolve_name(target)
+        if len(self.running) == 1:
+            return next(iter(self.running))
+        if self.running:
+            return cm.Error(
+                message="multiple dataflows running; pass --uuid or --name"
+            )
+        if len(self.archived) == 1:
+            return next(iter(self.archived))
+        return cm.Error(message="no dataflow running")
+
     def resolve_name(self, name_or_uuid: str) -> str:
         """uuid | unique name -> uuid (reference: lib.rs:90-122)."""
         if name_or_uuid in self.running or name_or_uuid in self.archived:
@@ -399,6 +421,36 @@ class Coordinator:
             for machine in df.machines:
                 self._metrics_waiters.pop((uuid, machine), None)
         return merge_snapshots([s for s in snapshots if isinstance(s, dict)])
+
+    async def request_trace(self, uuid: str) -> dict:
+        """Fan a TraceRequest out to every involved daemon and merge the
+        per-machine ring snapshots onto one clock-aligned timeline
+        (dora_tpu.tracing.merge_trace_snapshots). Works for archived
+        dataflows too — daemons keep finished dataflow state."""
+        from dora_tpu.tracing import merge_trace_snapshots
+
+        df = self.running.get(uuid)
+        if df is None and uuid in self.archived:
+            df = self.archived[uuid][0]
+        if df is None:
+            raise KeyError(f"unknown dataflow {uuid!r}")
+        loop = asyncio.get_running_loop()
+        futs = []
+        for machine in sorted(df.machines):
+            fut = loop.create_future()
+            self._trace_waiters[(uuid, machine)] = fut
+            self._daemon_send(machine, cm.TraceRequest(dataflow_id=uuid))
+            futs.append(fut)
+        try:
+            snapshots = await asyncio.wait_for(
+                asyncio.gather(*futs, return_exceptions=True), timeout=10
+            )
+        finally:
+            for machine in df.machines:
+                self._trace_waiters.pop((uuid, machine), None)
+        return merge_trace_snapshots(
+            [s for s in snapshots if isinstance(s, dict)]
+        )
 
     # ------------------------------------------------------------------
     # log streaming
@@ -523,21 +575,17 @@ class Coordinator:
             logs = await self.request_logs(uuid, request.node)
             return cm.LogsReply(logs=logs)
         if isinstance(request, cm.QueryMetrics):
-            target = request.dataflow_uuid or request.name
-            if target is not None:
-                uuid = self.resolve_name(target)
-            elif len(self.running) == 1:
-                uuid = next(iter(self.running))
-            elif self.running:
-                return cm.Error(
-                    message="multiple dataflows running; pass --uuid or --name"
-                )
-            elif len(self.archived) == 1:
-                uuid = next(iter(self.archived))
-            else:
-                return cm.Error(message="no dataflow running")
+            uuid = self._query_target(request.dataflow_uuid, request.name)
+            if isinstance(uuid, cm.Error):
+                return uuid
             metrics = await self.request_metrics(uuid)
             return cm.MetricsReply(dataflow_uuid=uuid, metrics=metrics)
+        if isinstance(request, cm.QueryTrace):
+            uuid = self._query_target(request.dataflow_uuid, request.name)
+            if isinstance(uuid, cm.Error):
+                return uuid
+            trace = await self.request_trace(uuid)
+            return cm.TraceReply(dataflow_uuid=uuid, trace=trace)
         if isinstance(request, cm.ListDataflows):
             entries = [
                 cm.DataflowListEntry(uuid=u, name=df.name)

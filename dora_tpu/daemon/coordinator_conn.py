@@ -178,6 +178,17 @@ async def run_attached(
                         ),
                     )
                 )
+            elif isinstance(event, cm.TraceRequest):
+                df = daemon.dataflows.get(event.dataflow_id)
+                outbox.put_nowait(
+                    cm.TraceReplyFromDaemon(
+                        dataflow_id=event.dataflow_id,
+                        machine_id=machine_id,
+                        trace=(
+                            daemon.trace_snapshot(df) if df is not None else {}
+                        ),
+                    )
+                )
             elif isinstance(event, cm.DestroyDaemon):
                 return
             else:

@@ -50,7 +50,7 @@ from dora_tpu.message.common import (
 )
 from dora_tpu.message import fastroute
 from dora_tpu.metrics import DataflowMetrics
-from dora_tpu.telemetry import FLIGHT
+from dora_tpu.telemetry import FLIGHT, OTEL_CTX_KEY, TRACING
 from dora_tpu.message.serde import (
     Timestamped,
     decode_timestamped,
@@ -69,6 +69,10 @@ DEFAULT_GRACE_S = 15.0
 #: in their own regions; the channel only carries control messages and
 #: inline payloads.
 SHMEM_CHANNEL_CAPACITY = 1 << 20
+
+#: Trace plane: cap on buffered ReportTrace events per node (oldest
+#: dropped first — same recency-wins policy as the ring itself).
+MAX_NODE_TRACE_EVENTS = 20_000
 
 
 @dataclass
@@ -145,6 +149,9 @@ class DataflowState:
     p2p_edges: set = field(default_factory=set)
     #: hot-path counters + latency histograms (dora_tpu.metrics)
     metrics: DataflowMetrics = field(default_factory=DataflowMetrics)
+    #: trace plane: node id -> flight-recorder events the node shipped
+    #: via ReportTrace (bounded; see MAX_NODE_TRACE_EVENTS)
+    node_traces: dict[str, list] = field(default_factory=dict)
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -162,9 +169,10 @@ class Daemon:
         self.machine_id = machine_id
         self.local_comm = local_comm
         self.uds_dir = uds_dir
-        # Re-read the flight-recorder env knobs: the daemon may be
-        # constructed long after module import (bench A/B legs, tests).
+        # Re-read the flight-recorder/tracing env knobs: the daemon may
+        # be constructed long after module import (bench A/B legs, tests).
         FLIGHT.configure_from_env()
+        TRACING.configure_from_env()
         self.clock = HLC()
         self.dataflows: dict[str, DataflowState] = {}
         self._server: asyncio.AbstractServer | None = None
@@ -536,6 +544,13 @@ class Daemon:
         df.metrics.count_link(sender, output_id, nbytes)
         if FLIGHT.enabled:
             FLIGHT.record("route", f"{sender}/{output_id}", nbytes)
+        if TRACING.active:
+            FLIGHT.record(
+                "t_route",
+                f"{sender}/{output_id}",
+                str(metadata.parameters.get(OTEL_CTX_KEY, "")),
+                max(0, time.time_ns() - send_ns) if send_ns else 0,
+            )
 
         remote_machines: set[str] = set()
         for target in receivers:
@@ -605,6 +620,12 @@ class Daemon:
         if FLIGHT.enabled:
             FLIGHT.record("fastroute_hit", label, fast.payload_len)
         send_ns = fast.timestamp.physical_ns
+        if TRACING.active:
+            # Context spliced off the wire by parse_send_message (no
+            # metadata object tree exists on this path).
+            FLIGHT.record(
+                "t_route", label, fast.ctx, max(0, time.time_ns() - send_ns)
+            )
         for rnode, input_id in receivers:
             if (sender, fast.output_id, rnode, input_id) in df.p2p_edges:
                 continue  # the sender published this edge peer-to-peer
@@ -660,6 +681,29 @@ class Daemon:
         snap = df.metrics.snapshot(depths)
         snap["fastroute"]["fallback_reasons"] = dict(fastroute.FALLBACKS)
         return snap
+
+    def trace_snapshot(self, df: DataflowState) -> dict:
+        """Per-machine trace snapshot for one dataflow — the payload of a
+        TraceRequest reply. Carries this daemon's own ring plus every
+        ring chunk its nodes shipped via ReportTrace, and a
+        ``(wall_ns, hlc_ns)`` pair captured back to back so the merge
+        (dora_tpu.tracing) can align this machine's wall stamps onto the
+        cluster HLC timeline. The daemon ring is process-wide, so
+        concurrent dataflows share its events."""
+        processes: dict[str, list] = {
+            nid: [list(e) for e in events]
+            for nid, events in df.node_traces.items()
+        }
+        daemon_events = [list(e) for e in FLIGHT.events()]
+        if daemon_events:
+            processes["(daemon)"] = daemon_events
+        hlc_ns = self.clock.new_timestamp().physical_ns
+        return {
+            "machine": self.machine_id,
+            "wall_ns": time.time_ns(),
+            "hlc_ns": hlc_ns,
+            "processes": processes,
+        }
 
     def _payload_bytes(self, df: DataflowState, data: Any) -> bytes | None:
         if data is None:
@@ -1073,6 +1117,11 @@ class Daemon:
                 )
             elif isinstance(msg, n2d.ReportDropTokens):
                 self.ack_tokens(df, node_id, msg.drop_tokens)
+            elif isinstance(msg, n2d.ReportTrace):
+                buf = df.node_traces.setdefault(node_id, [])
+                buf.extend(msg.events)
+                if len(buf) > MAX_NODE_TRACE_EVENTS:
+                    del buf[: len(buf) - MAX_NODE_TRACE_EVENTS]
             elif isinstance(msg, n2d.P2PAnnounce):
                 df.p2p_listeners[node_id] = dict(msg.listeners)
                 await self._reply(conn, d2n.ReplyResult())
@@ -1138,6 +1187,17 @@ class Daemon:
                             node_id, entry.input_id,
                             (deliver_ns - entry.send_ns) / 1000.0,
                         )
+                        if TRACING.active:
+                            # Daemon-side span covering queue wait: no
+                            # ctx (the wire path never decodes metadata
+                            # at delivery); the timeline still lines up
+                            # via the wall stamps.
+                            FLIGHT.record(
+                                "t_deliver",
+                                f"{node_id}/{entry.input_id}",
+                                None,
+                                max(0, deliver_ns - entry.send_ns),
+                            )
                     # Fast-path entries carry their wire image; others
                     # (timers, close events, shmem inputs) encode here.
                     wires.append(

@@ -82,6 +82,15 @@ class QueryMetrics:
 
 
 @message
+class QueryTrace:
+    """Fetch the merged, clock-aligned trace timeline of a dataflow
+    (running or finished). Resolution mirrors QueryMetrics."""
+
+    dataflow_uuid: str | None = None
+    name: str | None = None
+
+
+@message
 class LogSubscribe:
     """Turn this control connection into a live log stream for a dataflow."""
 
@@ -154,6 +163,12 @@ class MetricsReply:
 
 
 @message
+class TraceReply:
+    dataflow_uuid: str
+    trace: dict[str, Any]  # merged timeline (dora_tpu.tracing)
+
+
+@message
 class DaemonConnectedReply:
     connected: bool
 
@@ -223,6 +238,11 @@ class MetricsRequest:
 
 
 @message
+class TraceRequest:
+    dataflow_id: str
+
+
+@message
 class Heartbeat:
     pass
 
@@ -281,6 +301,13 @@ class MetricsReplyFromDaemon:
     dataflow_id: str
     machine_id: str
     metrics: dict[str, Any]  # per-machine snapshot (dora_tpu.metrics)
+
+
+@message
+class TraceReplyFromDaemon:
+    dataflow_id: str
+    machine_id: str
+    trace: dict[str, Any]  # per-machine snapshot (Daemon.trace_snapshot)
 
 
 @message

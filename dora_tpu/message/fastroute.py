@@ -9,7 +9,9 @@ building those subtrees as Python objects:
 
 * :func:`parse_send_message` shallow-parses a frame with
   ``msgpack.Unpacker`` — it reads the envelope headers, the output id and
-  the sender timestamp, *skips* the metadata subtree, and records the
+  the sender timestamp, *skips* the metadata subtree (when tracing is on,
+  a C-level unpack of the same byte span lifts the trace context out
+  instead; the body is still spliced, never re-encoded), and records the
   byte span covering the ``metadata``+``data`` fields.
 * :func:`build_input_event` splices that span into a pre-framed
   ``Timestamped(Input)`` wire image (msgpack is context-free, so an
@@ -28,7 +30,7 @@ from __future__ import annotations
 import msgpack
 
 from dora_tpu.clock import Timestamp
-from dora_tpu.telemetry import FLIGHT
+from dora_tpu.telemetry import FLIGHT, OTEL_CTX_KEY, TRACING
 
 #: Process-wide fallback tally by reason — answers "WHY is the fastroute
 #: hit ratio low" (the per-dataflow hit/fallback counters in
@@ -77,10 +79,10 @@ def _array_header(n: int) -> bytes:
 class FastSend:
     """A shallow-parsed ``Timestamped(SendMessage)`` frame."""
 
-    __slots__ = ("output_id", "body", "timestamp", "payload_len")
+    __slots__ = ("output_id", "body", "timestamp", "payload_len", "ctx")
 
     def __init__(self, output_id: str, body: bytes, timestamp: Timestamp,
-                 payload_len: int = 0):
+                 payload_len: int = 0, ctx: str = ""):
         self.output_id = output_id
         #: wire bytes spanning ``"metadata": <...>, "data": <...>`` —
         #: exactly the tail an Input event's field map needs
@@ -88,6 +90,9 @@ class FastSend:
         self.timestamp = timestamp
         #: inline payload bytes (metrics: routed bytes per link)
         self.payload_len = payload_len
+        #: serialized trace context from metadata (tracing on only) —
+        #: the body bytes still splice through verbatim
+        self.ctx = ctx
 
 
 def parse_send_message(frame) -> FastSend | None:
@@ -117,7 +122,19 @@ def parse_send_message(frame) -> FastSend | None:
         body_start = u.tell()
         if u.unpack() != "metadata":
             return _fallback("field-order")
-        u.skip()  # metadata subtree: bytes reused verbatim, never built
+        ctx = ""
+        if TRACING.active:
+            # Trace plane: lift the context out of metadata. This is a
+            # C-level plain-dict build of the subtree — the consumed byte
+            # span is identical to skip(), so the body still splices
+            # through verbatim; no object tree is decoded or re-encoded.
+            meta = u.unpack()
+            try:
+                ctx = meta["f"]["parameters"].get(OTEL_CTX_KEY) or ""
+            except (TypeError, KeyError, AttributeError):
+                ctx = ""
+        else:
+            u.skip()  # metadata subtree: bytes reused verbatim, never built
         if u.unpack() != "data":
             return _fallback("field-order")
         # The data value must be built (cheap: nil, or one C-level bin
@@ -146,7 +163,7 @@ def parse_send_message(frame) -> FastSend | None:
         return _fallback("parse-error")
     return FastSend(
         str(output_id), bytes(frame[body_start:body_end]), timestamp,
-        payload_len,
+        payload_len, str(ctx),
     )
 
 

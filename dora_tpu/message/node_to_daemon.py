@@ -81,6 +81,16 @@ class ReportDropTokens:
 
 
 @message
+class ReportTrace:
+    """Ship a chunk of the node's flight-recorder ring to the daemon
+    (trace plane; control channel, fire-and-forget). Each event is a
+    6-element slot ``[monotonic_ns, wall_ns, kind, a, b, c]`` — see
+    telemetry.FlightRecorder."""
+
+    events: list[list[Any]]
+
+
+@message
 class NextDropEvents:
     """Blocking poll on the drop channel for released drop tokens (regions
     of ours that no receiver references anymore)."""
@@ -120,4 +130,4 @@ class P2PEdgesRequest:
 
 
 def expects_reply(request: Any) -> bool:
-    return not isinstance(request, (SendMessage, ReportDropTokens))
+    return not isinstance(request, (SendMessage, ReportDropTokens, ReportTrace))

@@ -97,15 +97,22 @@ class Node:
     def __init__(self, node_id: str | None = None, daemon_addr: str | None = None):
         from dora_tpu.telemetry import (
             FLIGHT,
+            TRACING,
             install_flight_dump,
             install_stack_dump,
         )
 
         install_stack_dump()
+        # Tracing implies the ring (FLIGHT.configure_from_env): the ring
+        # is the trace storage the flusher ships to the daemon.
+        TRACING.configure_from_env()
         FLIGHT.configure_from_env()
         if FLIGHT.enabled:
             install_flight_dump()
         self._flight = FLIGHT
+        self._tracing = TRACING
+        #: ring position already shipped to the daemon (ReportTrace)
+        self._trace_cursor = 0
         #: per-output published message/byte counters (node-local view;
         #: the daemon's metrics plane is authoritative for routed counts)
         self._send_counts: dict[str, list] = {}
@@ -314,7 +321,28 @@ class Node:
     def _publish(self, output_id: str, metadata: Metadata, data: Any) -> None:
         """Route one output: peer-to-peer edges first (direct shmem
         exchange, ~32 µs), then the daemon SendMessage only when some
-        receiver still needs it (non-p2p local, remote, or none)."""
+        receiver still needs it (non-p2p local, remote, or none).
+
+        With tracing on, a child trace context (derived from any context
+        the caller already put in the metadata, e.g. the runtime's
+        on_event span) is injected so the daemon and receiver correlate,
+        and the publish is recorded as a ``t_send`` span."""
+        if not self._tracing.active:
+            return self._publish_inner(output_id, metadata, data)
+        from dora_tpu.telemetry import OTEL_CTX_KEY, child_context
+
+        params = metadata.parameters
+        ctx = child_context(str(params.get(OTEL_CTX_KEY, "")))
+        params[OTEL_CTX_KEY] = ctx
+        t0 = time.monotonic_ns()
+        try:
+            return self._publish_inner(output_id, metadata, data)
+        finally:
+            self._flight.record(
+                "t_send", output_id, ctx, time.monotonic_ns() - t0
+            )
+
+    def _publish_inner(self, output_id: str, metadata: Metadata, data: Any) -> None:
         nbytes = metadata.type_info.len
         counts = self._send_counts.get(output_id)
         if counts is None:
@@ -440,6 +468,22 @@ class Node:
     #: accumulate before the coalesced write (only when coalescing is on).
     FLUSH_LINGER_S = 0.0002
 
+    #: Trace plane: with tracing on the flusher's idle wait is bounded so
+    #: flight-recorder ring growth ships to the daemon periodically (the
+    #: ring would otherwise wrap and lose span records on busy nodes).
+    TRACE_FLUSH_S = 1.0
+
+    def _queue_trace_report(self) -> None:
+        """Queue ring growth since the last report as a fire-and-forget
+        ReportTrace (caller flushes the control channel)."""
+        events, self._trace_cursor = self._flight.events_since(
+            self._trace_cursor
+        )
+        if events:
+            self._control.queue(
+                n2d.ReportTrace(events=[list(e) for e in events])
+            )
+
     def _flush_loop(self) -> None:
         while True:
             with self._ack_cond:
@@ -448,7 +492,11 @@ class Node:
                     and self._control.buffered_bytes == 0
                     and not self._ack_closing
                 ):
-                    self._ack_cond.wait()
+                    if self._tracing.active:
+                        if not self._ack_cond.wait(self.TRACE_FLUSH_S):
+                            break  # idle tick: ship ring growth
+                    else:
+                        self._ack_cond.wait()
                 if (
                     self._ack_closing
                     and not self._pending_acks
@@ -462,6 +510,8 @@ class Node:
             try:
                 if tokens:
                     self._control.queue(n2d.ReportDropTokens(drop_tokens=tokens))
+                if self._tracing.active:
+                    self._queue_trace_report()
                 self._control.flush()
             except Exception:
                 return
@@ -513,6 +563,11 @@ class Node:
             self._ack_cond.notify()
         self._ack_thread.join(timeout=2)
         try:
+            if self._tracing.active:
+                # Final ring shipment (covers the tail the periodic
+                # flusher missed, incl. t_recv records from the event
+                # drain above); OutputsDone flushes the queue first.
+                self._queue_trace_report()
             self._control.request_ok(n2d.OutputsDone())
         except Exception:
             pass

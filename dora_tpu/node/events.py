@@ -26,6 +26,7 @@ from dora_tpu.message.common import (
     SharedMemoryData,
 )
 from dora_tpu.native import ShmemRegion
+from dora_tpu.telemetry import FLIGHT, OTEL_CTX_KEY, TRACING
 
 #: pump-internal marker: the daemon closed the stream (AllInputsClosed).
 _END = object()
@@ -259,6 +260,13 @@ class EventStream:
                 value=value,
                 metadata=dict(inner.metadata.parameters),
             )
+            if TRACING.active:
+                # Receiver end of the message span: the sender's context
+                # rode here in the metadata (spliced verbatim through the
+                # daemon's wire path).
+                ctx = event.metadata.get(OTEL_CTX_KEY)
+                if ctx:
+                    FLIGHT.record("t_recv", inner.id, str(ctx), 0)
             if token is not None:
                 # Ack when the user drops the event (CPython refcounting
                 # makes this prompt); the sender then reuses the region.

@@ -30,6 +30,7 @@ from dora_tpu.core.descriptor import (
     WasmSource,
 )
 from dora_tpu.node import Node
+from dora_tpu.telemetry import OTEL_CTX_KEY, span
 from dora_tpu.tpu.api import DoraStatus
 
 logger = logging.getLogger(__name__)
@@ -81,8 +82,9 @@ class PythonOperatorHost:
         if self.stopped:
             return DoraStatus.STOP
 
-        from dora_tpu.telemetry import OTEL_CTX_KEY, span
-
+        # With tracing off, span() is a single attribute check that
+        # forwards parent_ctx unchanged; with it on, the operator span
+        # parents the node's per-message t_send spans downstream.
         parent_ctx = str((event.get("metadata") or {}).get(OTEL_CTX_KEY, ""))
         with span(f"{self.definition.id}/on_event", parent_ctx) as ctx:
 

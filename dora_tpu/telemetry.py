@@ -21,6 +21,105 @@ OTEL_CTX_KEY = "open_telemetry_context"
 logger = logging.getLogger(__name__)
 
 
+# ---------------------------------------------------------------------------
+# tracing gate (hot-path: a single attribute check when off)
+# ---------------------------------------------------------------------------
+
+
+class TracingState:
+    """Process-wide tracing switch (``DORA_TRACING=1``).
+
+    The hot path (node publish, daemon route, event-stream recv) guards
+    every trace-plane action behind ``TRACING.active`` — one attribute
+    load when tracing is off. Daemons and nodes call
+    :meth:`configure_from_env` at startup so an env knob set after module
+    import (e.g. a bench A/B leg) still takes effect in-process.
+    """
+
+    __slots__ = ("active",)
+
+    def __init__(self, active: bool = False):
+        self.active = active
+
+    def configure_from_env(self) -> None:
+        self.active = os.environ.get("DORA_TRACING", "") not in ("", "0")
+
+
+TRACING = TracingState(os.environ.get("DORA_TRACING", "") not in ("", "0"))
+
+
+# ---------------------------------------------------------------------------
+# span / trace id generation (per-process base + counter; no per-message
+# os.urandom — one seed read per process, fork-safe via the pid check)
+# ---------------------------------------------------------------------------
+
+_U64 = (1 << 64) - 1
+_U128 = (1 << 128) - 1
+
+
+class _IdGen:
+    __slots__ = ("pid", "span_base", "trace_base", "count")
+
+    def __init__(self):
+        self.pid = -1  # forces a reseed on first use (and after fork)
+        self.span_base = 0
+        self.trace_base = 0
+        self.count = 0
+
+    def reseed(self) -> None:
+        self.pid = os.getpid()
+        self.span_base = int.from_bytes(os.urandom(8), "big")
+        self.trace_base = int.from_bytes(os.urandom(16), "big")
+        self.count = 0
+
+
+_IDS = _IdGen()
+
+
+def next_span_id() -> str:
+    """16-hex span id from the per-process random base + counter."""
+    g = _IDS
+    if g.pid != os.getpid():
+        g.reseed()
+    g.count += 1
+    return format((g.span_base + g.count) & _U64, "016x")
+
+
+def next_trace_id() -> str:
+    """32-hex trace id; the counter lands in the high half so trace ids
+    never collide with each other or with span ids."""
+    g = _IDS
+    if g.pid != os.getpid():
+        g.reseed()
+    g.count += 1
+    return format((g.trace_base + (g.count << 64)) & _U128, "032x")
+
+
+def child_context(parent_ctx: str = "") -> str:
+    """A serialized child trace context: same trace id as ``parent_ctx``
+    (fresh one if absent/malformed), new span id. The allocation-light
+    core of :func:`span`'s SDK-less fallback, callable directly from the
+    per-message hot path without generator overhead."""
+    trace_id = None
+    if parent_ctx:
+        parent = parse_otel_context(parent_ctx).get("traceparent")
+        if parent and parent.count("-") == 3:
+            trace_id = parent.split("-")[1]
+    if trace_id is None:
+        trace_id = next_trace_id()
+    return f"traceparent:00-{trace_id}-{next_span_id()}-01;"
+
+
+def trace_id_of(ctx: str) -> str | None:
+    """The 32-hex trace id inside a serialized context, or None."""
+    if not ctx:
+        return None
+    parent = parse_otel_context(ctx).get("traceparent")
+    if parent and parent.count("-") == 3:
+        return parent.split("-")[1]
+    return None
+
+
 def otlp_endpoint() -> str | None:
     """Single resolution rule for the OTLP export endpoint, shared by
     tracing and metrics: ``OTEL_EXPORTER_OTLP_ENDPOINT`` wins, with
@@ -45,63 +144,100 @@ class FlightRecorder:
     The message plane records route / enqueue / drop-oldest / coalesce
     flush / fastroute hit-or-fallback events here when enabled
     (``DORA_FLIGHT_RECORDER=1``; size via ``DORA_FLIGHT_RECORDER_SIZE``,
-    default 4096). Slots are preallocated lists mutated in place, so the
-    steady state allocates nothing; when disabled, :meth:`record` is a
-    single attribute check and return, so the hot path pays ~0.
+    default 4096). ``DORA_TRACING=1`` also enables the ring — it is the
+    storage for the trace plane's per-message span records
+    (``t_send`` / ``t_route`` / ``t_deliver`` / ``t_recv``). Slots are
+    preallocated lists mutated in place, so the steady state allocates
+    nothing; when disabled, :meth:`record` is a single attribute check
+    and return, so the hot path pays ~0.
 
-    Recording from several threads may interleave slot writes; the ring
-    is a forensic tool, not an exact log, and an occasionally torn slot
-    is an accepted trade for staying lock-free on the hot path. The ring
-    is dumped on SIGUSR2 alongside the asyncio task dump (daemons) or
-    via :func:`install_flight_dump` (nodes).
+    Slot layout: ``[monotonic_ns, wall_ns, kind, a, b, c]``. The wall
+    clock (``time.time_ns``, the base of the HLC physical component)
+    rides along so rings from different processes and machines merge
+    onto one timeline — monotonic clocks have per-process epochs and
+    cannot be compared across boundaries.
+
+    Recording stays lock-free; readers (:meth:`events`,
+    :meth:`events_since`) snapshot defensively and drop slots a
+    concurrent writer may have overwritten mid-copy. The ring is dumped
+    on SIGUSR2 alongside the asyncio task dump (daemons) or via
+    :func:`install_flight_dump` (nodes).
     """
 
     __slots__ = ("enabled", "_slots", "_size", "_idx")
 
     def __init__(self, size: int = 4096, enabled: bool = False):
         self._size = max(1, size)
-        self._slots = [[0, "", None, None] for _ in range(self._size)]
+        self._slots = [[0, 0, "", None, None, None] for _ in range(self._size)]
         self._idx = 0
         self.enabled = enabled
 
     def configure_from_env(self) -> None:
         """Re-read the env knobs (daemons/nodes call this at startup, so
         a knob set after module import — e.g. a bench A/B leg — still
-        takes effect in-process)."""
-        self.enabled = os.environ.get("DORA_FLIGHT_RECORDER", "") not in ("", "0")
+        takes effect in-process). A disabled->enabled toggle clears the
+        ring: events from a previous enablement must not leak into a new
+        capture."""
+        enabled = (
+            os.environ.get("DORA_FLIGHT_RECORDER", "") not in ("", "0")
+            or os.environ.get("DORA_TRACING", "") not in ("", "0")
+        )
         size = int(os.environ.get("DORA_FLIGHT_RECORDER_SIZE", "0") or "0")
         if size > 0 and size != self._size:
             self._size = size
-            self._slots = [[0, "", None, None] for _ in range(size)]
+            self._slots = [[0, 0, "", None, None, None] for _ in range(size)]
             self._idx = 0
+        if enabled and not self.enabled:
+            self.clear()
+        self.enabled = enabled
 
-    def record(self, kind: str, a=None, b=None) -> None:
+    def record(self, kind: str, a=None, b=None, c=None) -> None:
         if not self.enabled:
             return
         slot = self._slots[self._idx % self._size]
         slot[0] = time.monotonic_ns()
-        slot[1] = kind
-        slot[2] = a
-        slot[3] = b
+        slot[1] = time.time_ns()
+        slot[2] = kind
+        slot[3] = a
+        slot[4] = b
+        slot[5] = c
         self._idx += 1
 
+    def _snapshot(self, start: int) -> list[tuple]:
+        """Copy slots [start, idx) oldest first, then drop any prefix a
+        concurrent writer advanced over while we copied (those slots were
+        overwritten under us and may be torn)."""
+        idx = self._idx
+        start = max(start, idx - self._size)
+        out = [tuple(self._slots[i % self._size]) for i in range(start, idx)]
+        overrun = (self._idx - self._size) - start
+        if overrun > 0:
+            out = out[overrun:] if overrun < len(out) else []
+        # An unwritten slot has no kind (possible when a writer bumped
+        # _idx but hasn't filled the slot yet).
+        return [e for e in out if e[2]]
+
     def events(self) -> list[tuple]:
-        """Recorded events, oldest first (filled slots only)."""
-        n = min(self._idx, self._size)
-        start = self._idx - n
-        out = []
-        for i in range(start, self._idx):
-            t, kind, a, b = self._slots[i % self._size]
-            out.append((t, kind, a, b))
-        return out
+        """Recorded events, oldest first (filled slots only); safe to
+        call while another thread records."""
+        return self._snapshot(self._idx - min(self._idx, self._size))
+
+    def events_since(self, cursor: int) -> tuple[list[tuple], int]:
+        """Events recorded since ``cursor`` (a previous return value; 0
+        to start) plus the new cursor — the incremental-shipping API the
+        node flusher uses to stream ring growth to its daemon."""
+        idx = self._idx
+        return self._snapshot(max(cursor, idx - min(idx, self._size))), idx
 
     def clear(self) -> None:
         self._idx = 0
         for slot in self._slots:
             slot[0] = 0
-            slot[1] = ""
-            slot[2] = None
+            slot[1] = 0
+            slot[2] = ""
             slot[3] = None
+            slot[4] = None
+            slot[5] = None
 
     def dump(self, file=None) -> None:
         import sys
@@ -113,9 +249,9 @@ class FlightRecorder:
             f"{self._idx} recorded total)",
             file=file,
         )
-        for t, kind, a, b in events:
-            extra = " ".join(str(x) for x in (a, b) if x is not None)
-            print(f"  {t} {kind} {extra}".rstrip(), file=file)
+        for mono, _wall, kind, a, b, c in events:
+            extra = " ".join(str(x) for x in (a, b, c) if x is not None)
+            print(f"  {mono} {kind} {extra}".rstrip(), file=file)
         file.flush()
 
 
@@ -123,7 +259,10 @@ class FlightRecorder:
 #: Daemon()/Node() via configure_from_env so late env changes count.
 FLIGHT = FlightRecorder(
     size=int(os.environ.get("DORA_FLIGHT_RECORDER_SIZE", "4096") or "4096"),
-    enabled=os.environ.get("DORA_FLIGHT_RECORDER", "") not in ("", "0"),
+    enabled=(
+        os.environ.get("DORA_FLIGHT_RECORDER", "") not in ("", "0")
+        or os.environ.get("DORA_TRACING", "") not in ("", "0")
+    ),
 )
 
 
@@ -291,8 +430,8 @@ def span(name: str, parent_ctx: str = ""):
     outgoing metadata. Without the otel SDK (and with ``DORA_TRACING`` set)
     this synthesizes W3C-style traceparent ids so traces still correlate
     across processes; with tracing off it forwards the parent unchanged at
-    zero cost."""
-    if _tracer is None and os.environ.get("DORA_TRACING", "") in ("", "0"):
+    the cost of one attribute check."""
+    if _tracer is None and not TRACING.active:
         yield parent_ctx
         return
     if _tracer is not None:
@@ -308,14 +447,9 @@ def span(name: str, parent_ctx: str = ""):
             propagator.inject(carrier)
             yield serialize_context(carrier)
         return
-    # Fallback: keep a coherent traceparent chain without the SDK.
-    parent = parse_otel_context(parent_ctx).get("traceparent")
-    if parent and parent.count("-") == 3:
-        trace_id = parent.split("-")[1]
-    else:
-        trace_id = os.urandom(16).hex()
-    span_id = os.urandom(8).hex()
-    yield serialize_context({"traceparent": f"00-{trace_id}-{span_id}-01"})
+    # Fallback: keep a coherent traceparent chain without the SDK
+    # (per-process seeded ids — no os.urandom per span).
+    yield child_context(parent_ctx)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,275 @@
+"""Trace plane: cluster-wide timeline assembly and Chrome-trace export.
+
+The raw material is the flight-recorder rings (telemetry.FlightRecorder):
+every process records per-message span events — ``t_send`` (node publish),
+``t_route`` (daemon route, decoded or fastroute wire path), ``t_deliver``
+(daemon -> receiver queue delivery), ``t_recv`` (event-stream receive) —
+each slot carrying both ``monotonic_ns`` and the HLC wall clock. Nodes
+stream ring growth to their daemon (node_to_daemon.ReportTrace); the
+coordinator fans ``TraceRequest`` out to every machine and merges the
+per-machine snapshots here.
+
+Clock alignment: monotonic clocks have per-process epochs, so cross-process
+ordering uses the wall stamps. Each daemon snapshot carries a
+``(wall_ns, hlc_ns)`` pair captured back to back; the HLC physical
+component advances to the maximum clock observed anywhere in the cluster
+(clock.py), so ``hlc_ns - wall_ns`` is that machine's offset from the
+cluster's shared timeline and adding it aligns every machine's wall stamps
+onto one axis.
+
+Export is the Chrome trace event format (the ``traceEvents`` JSON that
+Perfetto and chrome://tracing load): one ``pid`` per (machine, process)
+track, ``ph:"X"`` complete spans for the per-message records (linked by
+the W3C trace id in ``args``), ``ph:"i"`` instants for drops, coalesce
+flushes, and fastroute fallbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dora_tpu.telemetry import trace_id_of
+
+# FlightRecorder slot indices (see telemetry.FlightRecorder docstring).
+MONO, WALL, KIND, A, B, C = range(6)
+
+#: Trace-plane span kinds -> Chrome-trace span name prefix. ``b`` holds
+#: the serialized trace context (t_deliver has none — the daemon doesn't
+#: decode metadata on the wire path at delivery time), ``c`` the span
+#: duration in ns.
+SPAN_KINDS = {
+    "t_send": "send",
+    "t_route": "route",
+    "t_deliver": "deliver",
+    "t_recv": "recv",
+}
+
+#: Hot-path flight events surfaced as instants (everything else recorded
+#: in the ring also exports as an instant, generically named).
+INSTANT_NAMES = {
+    "drop_oldest": "drop oldest",
+    "coalesce_flush": "coalesce flush",
+    "fastroute_fallback": "fastroute fallback",
+}
+
+_VALID_PH = {"X", "i", "M"}
+_VALID_SCOPES = {"g", "p", "t"}
+
+
+def merge_trace_snapshots(snapshots: list[dict | None]) -> dict:
+    """Merge per-machine daemon snapshots onto one clock-aligned timeline.
+
+    Each snapshot is ``Daemon.trace_snapshot`` output::
+
+        {"machine": str, "wall_ns": int, "hlc_ns": int,
+         "processes": {process_name: [[mono, wall, kind, a, b, c], ...]}}
+
+    Returns ``{"processes": [{"machine", "process", "events"}, ...]}``
+    with every event's wall stamp shifted by that machine's
+    ``hlc_ns - wall_ns`` offset onto the cluster HLC timeline.
+    """
+    processes: list[dict] = []
+    for snap in snapshots:
+        if not snap or not snap.get("processes"):
+            continue
+        offset = int(snap.get("hlc_ns", 0)) - int(snap.get("wall_ns", 0))
+        machine = str(snap.get("machine", "?"))
+        for process, events in sorted(snap["processes"].items()):
+            aligned = []
+            for e in events:
+                if len(e) < 6 or not e[KIND]:
+                    continue  # torn/foreign slot shipped by an old node
+                e = list(e)
+                e[WALL] = int(e[WALL]) + offset
+                aligned.append(e)
+            aligned.sort(key=lambda e: e[WALL])
+            processes.append(
+                {"machine": machine, "process": process, "events": aligned}
+            )
+    processes.sort(key=lambda p: (p["machine"], p["process"]))
+    return {"processes": processes}
+
+
+def _span_args(ctx) -> dict:
+    args: dict[str, Any] = {}
+    if ctx:
+        args["ctx"] = str(ctx)
+        trace_id = trace_id_of(str(ctx))
+        if trace_id:
+            args["trace_id"] = trace_id
+    return args
+
+
+def to_chrome_trace(merged: dict) -> dict:
+    """Chrome trace event JSON (Perfetto-loadable) from a merged trace.
+
+    One pid per (machine, process) with an ``M`` process_name record; a
+    ``ph:"X"`` complete span per message-plane record whose ``ts`` is the
+    span start (wall stamp is taken at record time = span end, so start =
+    wall - dur); ``ph:"i"`` instants for everything else. Timestamps are
+    microseconds (floats), rebased to the earliest event so Perfetto's
+    axis starts near zero.
+    """
+    events: list[dict] = []
+    processes = merged.get("processes", [])
+    base_ns = min(
+        (e[WALL] for p in processes for e in p["events"]), default=0
+    )
+    for pid, proc in enumerate(processes, start=1):
+        machine = proc["machine"]
+        track = f"{machine}/{proc['process']}" if machine else proc["process"]
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": track},
+            }
+        )
+        for e in proc["events"]:
+            kind = e[KIND]
+            wall_us = (e[WALL] - base_ns) / 1000.0
+            if kind in SPAN_KINDS:
+                dur_us = max(0, int(e[C] or 0)) / 1000.0
+                events.append(
+                    {
+                        "name": f"{SPAN_KINDS[kind]} {e[A]}",
+                        "ph": "X",
+                        "ts": max(0.0, wall_us - dur_us),
+                        "dur": dur_us,
+                        "pid": pid,
+                        "tid": 0,
+                        "cat": "message",
+                        "args": _span_args(e[B]),
+                    }
+                )
+            else:
+                name = INSTANT_NAMES.get(kind, kind)
+                extra = " ".join(str(x) for x in (e[A], e[B]) if x is not None)
+                events.append(
+                    {
+                        "name": f"{name} {extra}".rstrip(),
+                        "ph": "i",
+                        "ts": max(0.0, wall_us),
+                        "pid": pid,
+                        "tid": 0,
+                        "s": "p",
+                        "cat": "flight",
+                    }
+                )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(trace: Any) -> list[str]:
+    """Schema self-check for the exporter: every field Perfetto relies on
+    is present and well-typed. Returns a list of problems (empty = OK) —
+    wired into tier-1 and ``dora-tpu trace --check`` so a malformed field
+    fails the suite, not the user's Perfetto session."""
+    errors: list[str] = []
+    if not isinstance(trace, dict):
+        return ["trace is not an object"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{where}: name missing or not a string")
+        ph = ev.get("ph")
+        if ph not in _VALID_PH:
+            errors.append(f"{where}: ph {ph!r} not one of {sorted(_VALID_PH)}")
+            continue
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int) or isinstance(ev.get(key), bool):
+                errors.append(f"{where}: {key} missing or not an int")
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool) or ts < 0:
+            errors.append(f"{where}: ts missing, non-numeric, or negative")
+        if ph == "X":
+            dur = ev.get("dur")
+            if (
+                not isinstance(dur, (int, float))
+                or isinstance(dur, bool)
+                or dur < 0
+            ):
+                errors.append(f"{where}: dur missing, non-numeric, or negative")
+        if ph == "i" and ev.get("s") not in _VALID_SCOPES:
+            errors.append(f"{where}: instant scope s {ev.get('s')!r} invalid")
+    return errors
+
+
+def _sample_snapshots() -> list[dict]:
+    """Two synthetic machine snapshots with deliberate clock skew — the
+    offline input for :func:`self_check`."""
+    ctx = "traceparent:00-000102030405060708090a0b0c0d0e0f-0001020304050607-01;"
+    base = 1_700_000_000_000_000_000
+    # Machine A's wall clock lags the cluster HLC by 5 ms.
+    a = {
+        "machine": "A",
+        "wall_ns": base,
+        "hlc_ns": base + 5_000_000,
+        "processes": {
+            "(daemon)": [
+                [10, base + 1_200_000, "t_route", "sender/data", ctx, 150_000],
+                [11, base + 1_500_000, "t_deliver", "receiver/in", None, 400_000],
+                [12, base + 1_600_000, "drop_oldest", "receiver/in", 3, None],
+            ],
+            "sender": [
+                [20, base + 1_000_000, "t_send", "data", ctx, 90_000],
+                [21, base + 1_050_000, "coalesce_flush", 4, 4096, None],
+            ],
+        },
+    }
+    # Machine B's wall clock runs 2 ms ahead of the cluster HLC.
+    b = {
+        "machine": "B",
+        "wall_ns": base + 2_000_000,
+        "hlc_ns": base,
+        "processes": {
+            # Raw wall base+8.5ms = cluster base+6.5ms — after the sender's
+            # aligned base+6ms even though A's raw stamps lag B's.
+            "receiver": [
+                [30, base + 8_500_000, "t_recv", "in", ctx, 0],
+                [31, base + 8_600_000, "fastroute_fallback", "decode", None, None],
+            ],
+        },
+    }
+    return [a, b, None]
+
+
+def self_check() -> list[str]:
+    """Offline end-to-end check of merge + export + schema: build sample
+    snapshots (with clock skew), merge, export, validate — plus a few
+    semantic assertions the schema validator can't express. Returns
+    problems (empty = OK)."""
+    merged = merge_trace_snapshots(_sample_snapshots())
+    errors = validate_chrome_trace(to_chrome_trace(merged))
+    tracks = {(p["machine"], p["process"]) for p in merged["processes"]}
+    if len(tracks) != 3:
+        errors.append(f"expected 3 process tracks, got {sorted(tracks)}")
+    # Clock alignment: B's recv must land after A's send on the merged
+    # axis even though B's raw wall clock ran ahead.
+    walls = {
+        (p["process"], e[KIND]): e[WALL]
+        for p in merged["processes"]
+        for e in p["events"]
+    }
+    send = walls.get(("sender", "t_send"))
+    recv = walls.get(("receiver", "t_recv"))
+    if send is None or recv is None or recv <= send:
+        errors.append(f"alignment broken: send={send} recv={recv}")
+    trace = to_chrome_trace(merged)
+    ids = {
+        ev["args"].get("trace_id")
+        for ev in trace["traceEvents"]
+        if ev["ph"] == "X" and ev.get("args", {}).get("trace_id")
+    }
+    if len(ids) != 1:
+        errors.append(f"expected one linked trace id, got {ids}")
+    return errors

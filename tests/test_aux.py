@@ -22,6 +22,17 @@ def test_otel_context_codec_roundtrip():
 
 def test_span_fallback_chain(monkeypatch):
     monkeypatch.setenv("DORA_TRACING", "1")
+    # The hot-path gate is an attribute, re-read from the env only at
+    # process start / explicit reconfigure.
+    telemetry.TRACING.configure_from_env()
+    try:
+        _span_fallback_chain()
+    finally:
+        monkeypatch.undo()
+        telemetry.TRACING.configure_from_env()
+
+
+def _span_fallback_chain():
     with telemetry.span("a") as ctx1:
         parsed = telemetry.parse_otel_context(ctx1)
         trace_id = parsed["traceparent"].split("-")[1]

@@ -135,10 +135,13 @@ def test_flight_recorder_ring_wraps_oldest_first():
         r.record("route", "x", i)
     events = r.events()
     assert len(events) == 4
-    assert [e[3] for e in events] == [6, 7, 8, 9]
+    # Slot layout: [monotonic_ns, wall_ns, kind, a, b, c].
+    assert [e[4] for e in events] == [6, 7, 8, 9]
     stamps = [e[0] for e in events]
     assert stamps == sorted(stamps)
-    assert all(e[1] == "route" for e in events)
+    walls = [e[1] for e in events]
+    assert walls == sorted(walls) and all(w > 0 for w in walls)
+    assert all(e[2] == "route" for e in events)
 
 
 def test_flight_recorder_dump_and_clear():

@@ -26,8 +26,18 @@ def _traceparent(ctx: str) -> str:
     return tp
 
 
-def test_span_fallback_chain_is_coherent_across_three_hops(monkeypatch):
+@pytest.fixture
+def tracing_on(monkeypatch):
+    """DORA_TRACING=1 with the process-wide gate re-read, restored after
+    (the gate is an attribute, not an env read, on the hot path)."""
     monkeypatch.setenv("DORA_TRACING", "1")
+    tel.TRACING.configure_from_env()
+    yield
+    monkeypatch.undo()
+    tel.TRACING.configure_from_env()
+
+
+def test_span_fallback_chain_is_coherent_across_three_hops(tracing_on):
     assert tel._tracer is None  # fallback path, not the SDK
     with tel.span("hop-1") as ctx1:
         with tel.span("hop-2", ctx1) as ctx2:
@@ -42,14 +52,46 @@ def test_span_fallback_chain_is_coherent_across_three_hops(monkeypatch):
 
 def test_span_disabled_forwards_parent_unchanged(monkeypatch):
     monkeypatch.delenv("DORA_TRACING", raising=False)
+    tel.TRACING.configure_from_env()
     with tel.span("anything", "traceparent:00-aa-bb-01;") as ctx:
         assert ctx == "traceparent:00-aa-bb-01;"
 
 
-def test_span_fallback_tolerates_malformed_parent(monkeypatch):
-    monkeypatch.setenv("DORA_TRACING", "1")
+def test_span_fallback_tolerates_malformed_parent(tracing_on):
     with tel.span("hop", "traceparent:garbage;") as ctx:
         _traceparent(ctx)  # fresh, well-formed ids
+
+
+def test_span_ids_come_from_process_base_plus_counter(monkeypatch):
+    """Satellite regression: the SDK-less fallback must not call
+    os.urandom per span — one seed read per process, then arithmetic."""
+    import os as os_mod
+
+    tel._IDS.reseed()  # consume the lazy seed for this process
+    calls: list[int] = []
+    real_urandom = os_mod.urandom
+
+    def counting(n):
+        calls.append(n)
+        return real_urandom(n)
+
+    monkeypatch.setattr(os_mod, "urandom", counting)
+    ids = {tel.next_span_id() for _ in range(100)}
+    traces = {tel.next_trace_id() for _ in range(100)}
+    assert calls == []  # zero urandom reads across 200 ids
+    assert len(ids) == 100 and len(traces) == 100
+    assert all(len(i) == 16 for i in ids)
+    assert all(len(t) == 32 for t in traces)
+
+
+def test_child_context_keeps_trace_id_and_changes_span_id():
+    root = tel.child_context("")
+    child = tel.child_context(root)
+    assert tel.trace_id_of(child) == tel.trace_id_of(root)
+    assert _traceparent(child) != _traceparent(root)
+    # Malformed parents get fresh ids rather than propagating garbage.
+    fresh = tel.child_context("traceparent:nope;")
+    assert tel.trace_id_of(fresh) is not None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,456 @@
+"""Trace plane: span-id generation, flight-recorder concurrency and
+re-enable semantics, fastroute context splicing, snapshot merge with HLC
+clock alignment, Chrome-trace export schema, and the end-to-end
+QueryTrace path (node spans -> daemon rings -> coordinator merge -> CLI
+Perfetto export)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+import dora_tpu.telemetry as tel
+from dora_tpu.coordinator import Coordinator
+from dora_tpu.daemon.core import Daemon
+from dora_tpu.message import coordinator as cm
+from dora_tpu.telemetry import FlightRecorder, trace_id_of
+from dora_tpu.tracing import (
+    merge_trace_snapshots,
+    self_check,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: concurrency + enable-toggle (satellite regression tests)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_concurrent_read_returns_whole_slots():
+    """events() while another thread records: every returned slot is a
+    well-formed 6-tuple (the defensive snapshot drops slots the writer
+    overran mid-copy instead of returning torn data)."""
+    r = FlightRecorder(size=64, enabled=True)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            r.record("route", "x", i)
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(300):
+            for e in r.events():
+                assert len(e) == 6
+                assert isinstance(e[2], str) and e[2] == "route"
+                assert isinstance(e[0], int) and e[0] > 0
+                assert isinstance(e[1], int) and e[1] > 0
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_flight_recorder_reenable_clears_stale_events(monkeypatch):
+    monkeypatch.delenv("DORA_TRACING", raising=False)
+    r = FlightRecorder(size=8, enabled=True)
+    r.record("route", "stale", 1)
+    monkeypatch.setenv("DORA_FLIGHT_RECORDER", "0")
+    r.configure_from_env()
+    assert not r.enabled
+    assert r.events() != []  # disabled keeps the forensic ring readable
+    monkeypatch.setenv("DORA_FLIGHT_RECORDER", "1")
+    r.configure_from_env()
+    assert r.enabled
+    assert r.events() == []  # a new capture must not contain old events
+
+
+def test_tracing_env_enables_the_ring(monkeypatch):
+    monkeypatch.delenv("DORA_FLIGHT_RECORDER", raising=False)
+    monkeypatch.setenv("DORA_TRACING", "1")
+    r = FlightRecorder(size=8, enabled=False)
+    r.configure_from_env()
+    assert r.enabled  # the ring is the trace plane's storage
+
+
+def test_flight_recorder_events_since_cursor():
+    r = FlightRecorder(size=8, enabled=True)
+    r.record("t_send", "a", "ctx", 1)
+    first, cur = r.events_since(0)
+    assert [e[2] for e in first] == ["t_send"]
+    again, cur2 = r.events_since(cur)
+    assert again == [] and cur2 == cur
+    r.record("t_recv", "b", "ctx", 0)
+    fresh, _ = r.events_since(cur)
+    assert [e[2] for e in fresh] == ["t_recv"]
+
+
+def test_flight_recorder_events_since_survives_wrap():
+    r = FlightRecorder(size=4, enabled=True)
+    r.record("route", "x", 0)
+    _, cur = r.events_since(0)
+    for i in range(10):  # wraps well past the cursor
+        r.record("route", "x", i + 1)
+    events, _ = r.events_since(cur)
+    assert len(events) == 4  # only what the ring still holds
+    assert [e[4] for e in events] == [7, 8, 9, 10]
+
+
+# ---------------------------------------------------------------------------
+# fastroute: context splices through without a decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tracing_on(monkeypatch):
+    monkeypatch.setenv("DORA_TRACING", "1")
+    tel.TRACING.configure_from_env()
+    tel.FLIGHT.configure_from_env()
+    yield
+    monkeypatch.undo()
+    tel.TRACING.configure_from_env()
+    tel.FLIGHT.configure_from_env()
+    tel.FLIGHT.clear()
+
+
+def _send_frame(ctx: str):
+    from dora_tpu.clock import HLC
+    from dora_tpu.message import node_to_daemon as n2d
+    from dora_tpu.message.common import InlineData, Metadata, TypeInfo
+    from dora_tpu.message.serde import encode_timestamped
+
+    msg = n2d.SendMessage(
+        output_id="data",
+        metadata=Metadata(
+            type_info=TypeInfo(encoding="raw", len=3),
+            parameters={tel.OTEL_CTX_KEY: ctx},
+        ),
+        data=InlineData(data=b"abc"),
+    )
+    return encode_timestamped(msg, HLC())
+
+
+def test_fastroute_lifts_ctx_without_changing_the_body(tracing_on):
+    from dora_tpu.message import fastroute
+
+    ctx = tel.child_context("")
+    frame = _send_frame(ctx)
+    fast = fastroute.parse_send_message(frame)
+    assert fast is not None
+    assert fast.ctx == ctx
+    # Tracing off: same spliced body bytes, no ctx — the wire fast path
+    # is byte-identical either way.
+    tel.TRACING.active = False
+    try:
+        fast_off = fastroute.parse_send_message(frame)
+    finally:
+        tel.TRACING.active = True
+    assert fast_off is not None
+    assert fast_off.body == fast.body
+    assert fast_off.ctx == ""
+
+
+def test_fastroute_tolerates_metadata_without_ctx(tracing_on):
+    from dora_tpu.clock import HLC
+    from dora_tpu.message import fastroute
+    from dora_tpu.message import node_to_daemon as n2d
+    from dora_tpu.message.common import Metadata, TypeInfo
+    from dora_tpu.message.serde import encode_timestamped
+
+    msg = n2d.SendMessage(
+        output_id="data",
+        metadata=Metadata(
+            type_info=TypeInfo(encoding="raw", len=0), parameters={}
+        ),
+        data=None,
+    )
+    fast = fastroute.parse_send_message(encode_timestamped(msg, HLC()))
+    assert fast is not None and fast.ctx == ""
+
+
+# ---------------------------------------------------------------------------
+# merge + clock alignment + Chrome export schema
+# ---------------------------------------------------------------------------
+
+
+def test_merge_aligns_wall_clocks_onto_the_hlc_timeline():
+    base = 1_000_000_000_000
+    # Machine A's wall clock lags the cluster HLC by exactly 1 ms.
+    a = {
+        "machine": "A",
+        "wall_ns": base,
+        "hlc_ns": base + 1_000_000,
+        "processes": {"sender": [[1, base + 500, "t_send", "out", "c", 100]]},
+    }
+    # Machine B is already on the cluster clock.
+    b = {
+        "machine": "B",
+        "wall_ns": base,
+        "hlc_ns": base,
+        "processes": {"recv": [[2, base + 700, "t_recv", "in", "c", 0]]},
+    }
+    merged = merge_trace_snapshots([a, b, None, {}])
+    by_proc = {p["process"]: p["events"] for p in merged["processes"]}
+    assert by_proc["sender"][0][1] == base + 500 + 1_000_000
+    assert by_proc["recv"][0][1] == base + 700
+    # Torn/short slots are dropped, not exported.
+    c = dict(a, processes={"x": [[1, 2, "", None, None, None], [0]]})
+    assert merge_trace_snapshots([c])["processes"][0]["events"] == []
+
+
+def test_chrome_export_has_valid_perfetto_fields():
+    merged = merge_trace_snapshots(
+        [
+            {
+                "machine": "A",
+                "wall_ns": 0,
+                "hlc_ns": 0,
+                "processes": {
+                    "n": [
+                        [1, 2_000_000, "t_send", "out",
+                         "traceparent:00-" + "ab" * 16 + "-" + "cd" * 8 + "-01;",
+                         500_000],
+                        [2, 2_100_000, "drop_oldest", "n/in", 3, None],
+                    ]
+                },
+            }
+        ]
+    )
+    trace = to_chrome_trace(merged)
+    assert validate_chrome_trace(trace) == []
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(meta) == 1 and meta[0]["args"]["name"] == "A/n"
+    assert len(spans) == 1 and len(instants) == 1
+    span = spans[0]
+    assert span["name"] == "send out"
+    assert span["dur"] == 500.0  # ns -> us
+    assert span["ts"] >= 0 and span["args"]["trace_id"] == "ab" * 16
+    assert instants[0]["s"] == "p"
+    assert trace["displayTimeUnit"] == "ms"
+
+
+def test_validator_catches_malformed_events():
+    assert validate_chrome_trace([]) == ["trace is not an object"]
+    assert validate_chrome_trace({}) == ["traceEvents missing or not a list"]
+    bad = {
+        "traceEvents": [
+            {"name": "", "ph": "X", "ts": 0, "dur": 0, "pid": 1, "tid": 0},
+            {"name": "n", "ph": "Q", "ts": 0, "pid": 1, "tid": 0},
+            {"name": "n", "ph": "X", "ts": -1, "dur": -2, "pid": 1, "tid": 0},
+            {"name": "n", "ph": "i", "ts": 0, "pid": "one", "tid": 0, "s": "z"},
+            {"name": "n", "ph": "X", "ts": True, "dur": 1, "pid": 1, "tid": 0},
+        ]
+    }
+    problems = validate_chrome_trace(bad)
+    assert len(problems) >= 6
+    assert any("ph 'Q'" in p for p in problems)
+    assert any("negative" in p for p in problems)
+    assert any("scope" in p for p in problems)
+
+
+def test_trace_export_schema_self_check():
+    """Tier-1 guard (satellite): a malformed Chrome-trace field fails the
+    suite, not the user's Perfetto session."""
+    assert self_check() == []
+
+
+def test_cli_trace_check_flag(capsys):
+    from dora_tpu.cli.main import main as cli_main
+
+    assert cli_main(["trace", "--check"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# end to end: one trace id spans sender node -> daemon -> receiver node
+# ---------------------------------------------------------------------------
+
+
+COUNT = 5
+
+
+def chain_spec() -> dict:
+    data = str(list(range(COUNT)))
+    return {
+        "nodes": [
+            {
+                "id": "sender",
+                "path": "module:dora_tpu.nodehub.pyarrow_sender",
+                "outputs": ["data"],
+                "env": {"DATA": data, "COUNT": str(COUNT)},
+            },
+            {
+                "id": "receiver",
+                "path": "module:dora_tpu.nodehub.pyarrow_assert",
+                "inputs": {"in": "sender/data"},
+                "env": {"DATA": data, "MIN_COUNT": str(COUNT)},
+            },
+        ]
+    }
+
+
+async def _wait_machines(coord, expected, timeout: float = 10):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await coord.handle_control_request(cm.ConnectedMachines())
+        if set(reply.machines) >= expected:
+            return
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"machines {expected} never registered")
+        await asyncio.sleep(0.05)
+
+
+async def _wait_finished(coord, uuid, timeout: float = 60):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        reply = await coord.handle_control_request(cm.Check(dataflow_uuid=uuid))
+        if isinstance(reply, cm.DataflowStopped):
+            return reply.result
+        if isinstance(reply, cm.Error):
+            raise AssertionError(reply.message)
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError("dataflow never finished")
+        await asyncio.sleep(0.1)
+
+
+def _ids_of(events, kind, field=4):
+    return {
+        trace_id_of(str(e[field] or ""))
+        for e in events
+        if e[2] == kind and e[field]
+    } - {None}
+
+
+def test_query_trace_end_to_end(tmp_path, monkeypatch, capsys):
+    # P2P edges bypass the daemon; force the daemon route so the trace
+    # covers send -> route -> deliver -> recv across three processes
+    # (sender node, daemon, receiver node).
+    monkeypatch.setenv("DORA_P2P", "0")
+    monkeypatch.setenv("DORA_TRACING", "1")
+    tel.TRACING.configure_from_env()
+    tel.FLIGHT.configure_from_env()
+    tel.FLIGHT.clear()
+
+    out_path = tmp_path / "trace.json"
+    cli_out: dict = {}
+
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        daemon = Daemon()
+        task = asyncio.create_task(
+            daemon.run(f"127.0.0.1:{coord.daemon_port}", "A")
+        )
+        try:
+            await _wait_machines(coord, {"A"})
+            start = await coord.handle_control_request(
+                cm.Start(
+                    dataflow=chain_spec(),
+                    name="traced",
+                    local_working_dir=str(tmp_path),
+                )
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+            result = await _wait_finished(coord, start.uuid)
+            assert result.is_ok(), result.errors()
+
+            # Finished dataflows stay queryable (daemon keeps the rings).
+            reply = await coord.handle_control_request(
+                cm.QueryTrace(dataflow_uuid=start.uuid)
+            )
+            assert isinstance(reply, cm.TraceReply), reply
+            procs = {
+                p["process"]: p["events"] for p in reply.trace["processes"]
+            }
+            assert {"sender", "receiver", "(daemon)"} <= set(procs), procs
+
+            send_ids = _ids_of(procs["sender"], "t_send")
+            route_ids = _ids_of(procs["(daemon)"], "t_route")
+            recv_ids = _ids_of(procs["receiver"], "t_recv")
+            crossing = send_ids & route_ids & recv_ids
+            assert crossing, (send_ids, route_ids, recv_ids)
+            assert len(send_ids) >= COUNT  # one fresh trace per message
+            assert any(e[2] == "t_deliver" for e in procs["(daemon)"])
+
+            # Resolution by name mirrors the metrics plane.
+            by_name = await coord.handle_control_request(
+                cm.QueryTrace(name="traced")
+            )
+            assert isinstance(by_name, cm.TraceReply), by_name
+            assert by_name.dataflow_uuid == start.uuid
+
+            # The CLI exports Perfetto-loadable JSON over the real
+            # control port.
+            from dora_tpu.cli.main import main as cli_main
+
+            addr = f"127.0.0.1:{coord.control_port}"
+            cli_out["rc"] = await asyncio.to_thread(
+                cli_main,
+                [
+                    "trace", "--uuid", start.uuid,
+                    "--coordinator-addr", addr,
+                    "--out", str(out_path),
+                ],
+            )
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            task.cancel()
+            await coord.close()
+            tel.TRACING.configure_from_env()
+            tel.FLIGHT.configure_from_env()
+
+    try:
+        asyncio.run(main())
+    finally:
+        monkeypatch.undo()
+        tel.TRACING.configure_from_env()
+        tel.FLIGHT.configure_from_env()
+        tel.FLIGHT.clear()
+
+    assert cli_out["rc"] == 0
+    assert "Perfetto" in capsys.readouterr().out
+    trace = json.loads(out_path.read_text())
+    assert validate_chrome_trace(trace) == []
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans, "no spans exported"
+    for ev in spans:
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    # One trace id crosses >= 3 process tracks (sender, daemon, receiver)
+    # with clock-aligned, non-negative durations.
+    pids_by_trace: dict[str, set[int]] = {}
+    for ev in spans + [e for e in events if e["ph"] == "i"]:
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid:
+            pids_by_trace.setdefault(tid, set()).add(ev["pid"])
+    assert any(len(pids) >= 3 for pids in pids_by_trace.values()), (
+        pids_by_trace
+    )
+
+
+def test_query_trace_unknown_dataflow():
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        try:
+            reply = await coord.handle_control_request(
+                cm.QueryTrace(dataflow_uuid="no-such-uuid")
+            )
+            assert isinstance(reply, cm.Error)
+            empty = await coord.handle_control_request(cm.QueryTrace())
+            assert isinstance(empty, cm.Error)
+            assert "no dataflow" in empty.message
+        finally:
+            await coord.close()
+
+    asyncio.run(main())
