@@ -428,6 +428,37 @@ def tracing_ab_leg() -> dict:
     }
 
 
+def serving_engine_ab() -> dict:
+    """Paged-vs-dense serving engine A/B (tools/bench_serving): decode
+    tok/s + TTFT p50/p99 at 4 streams (both engines, the ±3% parity
+    axis) and at 16 streams (paged 16-slot pool vs dense 4-slot queue,
+    SAME KV HBM). Runs in a fresh subprocess so the accelerator isn't
+    claimed by the bench parent (same rule as serving_fps)."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, "-m", "dora_tpu.tools.bench_serving"],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "streams4" in row:
+            data = row
+    if proc.returncode != 0 or data is None:
+        return {
+            "streams4": None,
+            "streams16": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -572,6 +603,15 @@ def main() -> int:
         }
 
     try:
+        engine_ab = serving_engine_ab()
+    except Exception as exc:
+        engine_ab = {
+            "streams4": None,
+            "streams16": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -603,6 +643,7 @@ def main() -> int:
         "small_msg_detail": small,
         "recorder_ab": recorder_ab,
         "tracing_ab": tracing_ab,
+        "serving_engine_ab": engine_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

@@ -102,4 +102,39 @@ def render_metrics(
             ["INPUT", "DEPTH", "DROPS", "P50", "P90", "P99", "DELIVERED"],
             input_rows,
         )
+
+    serving = snap.get("serving", {})
+    if serving:
+        prev_serving = (prev or {}).get("serving", {})
+        serving_rows = []
+        for nid in sorted(serving):
+            s = serving[nid]
+            ttft = s.get("ttft_us", {})
+            toks = s.get("decode_tokens", 0)
+            if interval:
+                before = prev_serving.get(nid, {})
+                tps = f"{(toks - before.get('decode_tokens', 0)) / interval:.1f}"
+            else:
+                tps = "-"
+            pages = (
+                f"{s.get('free_pages', 0)}/{s.get('total_pages', 0)}"
+                if s.get("total_pages")
+                else "-"
+            )
+            serving_rows.append([
+                f"{nid} ({s.get('engine', '?')})",
+                f"{s.get('slots_active', 0)}/{s.get('slots_total', 0)}",
+                pages,
+                str(s.get("backlog_depth", 0)),
+                str(toks),
+                tps,
+                _fmt_us(ttft.get("p50_us")),
+                _fmt_us(ttft.get("p99_us")),
+                str(s.get("requests", 0)),
+            ])
+        lines += [""] + _table(
+            ["SERVING", "SLOTS", "PAGES", "BACKLOG", "TOKENS", "TOK/S",
+             "TTFT P50", "TTFT P99", "REQS"],
+            serving_rows,
+        )
     return "\n".join(lines).rstrip() + "\n"

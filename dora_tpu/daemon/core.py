@@ -152,6 +152,9 @@ class DataflowState:
     #: trace plane: node id -> flight-recorder events the node shipped
     #: via ReportTrace (bounded; see MAX_NODE_TRACE_EVENTS)
     node_traces: dict[str, list] = field(default_factory=dict)
+    #: serving plane: node id -> latest ServingMetrics snapshot the node
+    #: shipped via ReportServing (latest-wins; snapshots are cumulative)
+    node_serving: dict[str, dict] = field(default_factory=dict)
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -680,6 +683,10 @@ class Daemon:
                     depths[f"{nid}/{input_id}"] = count
         snap = df.metrics.snapshot(depths)
         snap["fastroute"]["fallback_reasons"] = dict(fastroute.FALLBACKS)
+        if df.node_serving:
+            snap["serving"] = {
+                nid: dict(s) for nid, s in df.node_serving.items()
+            }
         return snap
 
     def trace_snapshot(self, df: DataflowState) -> dict:
@@ -1122,6 +1129,8 @@ class Daemon:
                 buf.extend(msg.events)
                 if len(buf) > MAX_NODE_TRACE_EVENTS:
                     del buf[: len(buf) - MAX_NODE_TRACE_EVENTS]
+            elif isinstance(msg, n2d.ReportServing):
+                df.node_serving[node_id] = msg.snapshot
             elif isinstance(msg, n2d.P2PAnnounce):
                 df.p2p_listeners[node_id] = dict(msg.listeners)
                 await self._reply(conn, d2n.ReplyResult())

@@ -91,6 +91,17 @@ class ReportTrace:
 
 
 @message
+class ReportServing:
+    """Ship the serving node's engine metrics snapshot to the daemon
+    (metrics plane; control channel, fire-and-forget). The snapshot is
+    metrics.ServingMetrics.snapshot() — slots/pages gauges, cumulative
+    token counters and the TTFT histogram; the daemon keeps the latest
+    per node and splices it into its MetricsRequest reply."""
+
+    snapshot: dict[str, Any]
+
+
+@message
 class NextDropEvents:
     """Blocking poll on the drop channel for released drop tokens (regions
     of ours that no receiver references anymore)."""
@@ -130,4 +141,6 @@ class P2PEdgesRequest:
 
 
 def expects_reply(request: Any) -> bool:
-    return not isinstance(request, (SendMessage, ReportDropTokens, ReportTrace))
+    return not isinstance(
+        request, (SendMessage, ReportDropTokens, ReportTrace, ReportServing)
+    )

@@ -141,6 +141,54 @@ class DataflowMetrics:
         }
 
 
+class ServingMetrics:
+    """Node-side serving counters (the LLM server's view of its engine).
+
+    Lives in the serving node's process, shipped to its daemon as a
+    fire-and-forget ``n2d.ReportServing`` snapshot (same plane as
+    ReportTrace) and surfaced through the coordinator's metrics fan-out
+    next to the dataflow counters — ``dora-tpu metrics [--watch]`` shows
+    slots, pages, backlog, decode tokens/s and the TTFT histogram.
+
+    Counters are cumulative (the CLI derives rates from consecutive
+    snapshots in watch mode); gauges are set just before ``snapshot``.
+    """
+
+    __slots__ = (
+        "ttft", "decode_tokens", "prefill_chunks", "requests", "rejected",
+        "slots_active", "slots_total", "free_pages", "total_pages",
+        "backlog_depth", "engine",
+    )
+
+    def __init__(self, engine: str = "dense"):
+        self.ttft = Histogram()
+        self.decode_tokens = 0
+        self.prefill_chunks = 0
+        self.requests = 0
+        self.rejected = 0
+        self.slots_active = 0
+        self.slots_total = 0
+        self.free_pages = 0
+        self.total_pages = 0
+        self.backlog_depth = 0
+        self.engine = engine
+
+    def snapshot(self) -> dict:
+        return {
+            "engine": self.engine,
+            "requests": self.requests,
+            "rejected": self.rejected,
+            "decode_tokens": self.decode_tokens,
+            "prefill_chunks": self.prefill_chunks,
+            "slots_active": self.slots_active,
+            "slots_total": self.slots_total,
+            "free_pages": self.free_pages,
+            "total_pages": self.total_pages,
+            "backlog_depth": self.backlog_depth,
+            "ttft_us": self.ttft.snapshot(),
+        }
+
+
 def merge_snapshots(snapshots: list[dict]) -> dict:
     """Aggregate per-daemon snapshots into one cluster view (coordinator).
 
@@ -153,9 +201,12 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
     hits = falls = 0
     lat_counts: dict[str, list[int]] = {}
     lat_sum: dict[str, float] = {}
+    serving: dict[str, dict] = {}
     for snap in snapshots:
         if not snap:
             continue
+        # Each serving node lives on exactly one machine: union.
+        serving.update(snap.get("serving", {}))
         for key, v in snap.get("links", {}).items():
             entry = links.setdefault(key, {"msgs": 0, "bytes": 0})
             entry["msgs"] += v.get("msgs", 0)
@@ -182,7 +233,7 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
         for p in (50, 90, 99):
             entry[f"p{p}_us"] = percentile_from_counts(counts, p)
         latency[key] = entry
-    return {
+    out = {
         "links": links,
         "drops": drops,
         "queue_depth": depth,
@@ -193,3 +244,6 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
         },
         "latency_us": latency,
     }
+    if serving:
+        out["serving"] = serving
+    return out

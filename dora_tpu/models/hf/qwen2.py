@@ -246,6 +246,118 @@ def make_batch_engine(params, cfg: Qwen2Config, *, max_slots: int = 4,
     )
 
 
+def fused_paged_batch_step(params, cfg, tokens, pools, positions,
+                           block_tables):
+    """One fused decode step for B independent sequences over PAGED KV
+    pools. tokens/positions: [B] int32; block_tables: [B, max_pages]
+    int32 (0 = the reserved null page); pools: {layer: {k/v:
+    [P, KV, page, hd]}}. Returns (greedy [B], pools). The paged
+    engine's inner step (models/batch_engine.PagedBatchEngine)."""
+    from dora_tpu.models import vlm as _vlm
+    from dora_tpu.ops import decode_block as DB
+
+    dtype = L.compute_dtype()
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim,
+                                base=cfg.rope_theta)
+    cos_rows, sin_rows = DB.rope_rows_at(cos_t, sin_t, positions)
+    x = params["embed"].astype(dtype)[tokens]  # [B, dim]
+    return _vlm.fused_paged_pass_batch(
+        params, x, pools, positions, block_tables, cos_rows, sin_rows,
+        heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        layers=cfg.layers, eps=cfg.norm_eps,
+    )
+
+
+def fused_paged_chunk_step(params, cfg, chunk_ids, pools, position,
+                           block_table):
+    """One prefill chunk into paged pools: chunk_ids [C] int32 at
+    positions ``position..position+C-1`` (both page-multiples; the tail
+    chunk is right-padded — pad rows land beyond ``true_len`` and are
+    overwritten by decode before they become attendable, exactly the
+    :func:`prefill_padded` argument). ``position`` is a TRACED scalar,
+    so every chunk of every prompt shares ONE compiled program.
+    Returns (greedy [C], pools)."""
+    from dora_tpu.models import vlm as _vlm
+    from dora_tpu.ops import decode_block as DB
+
+    dtype = L.compute_dtype()
+    c = chunk_ids.shape[0]
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim,
+                                base=cfg.rope_theta)
+    cos_rows, sin_rows = DB.rope_rows(cos_t, sin_t, position, c)
+    x = params["embed"].astype(dtype)[chunk_ids]  # [C, dim]
+    return _vlm.fused_paged_pass_chunk(
+        params, x, pools, position, block_table, cos_rows, sin_rows,
+        heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        layers=cfg.layers, eps=cfg.norm_eps,
+    )
+
+
+def init_page_pool(cfg: Qwen2Config, num_pages: int, page_size: int,
+                   dtype=None):
+    """Per-layer paged KV pools: {layer: {k/v: [P, KV, page, hd]}}.
+    Page 0 is reserved as the null page (idle slots' masked rows write
+    there harmlessly); HBM scales with pages actually held, not
+    slots x max_seq."""
+    dtype = dtype or L.compute_dtype()
+    return {
+        str(i): {
+            "k": jnp.zeros(
+                (num_pages, cfg.kv_heads, page_size, cfg.head_dim), dtype
+            ),
+            "v": jnp.zeros(
+                (num_pages, cfg.kv_heads, page_size, cfg.head_dim), dtype
+            ),
+        }
+        for i in range(cfg.layers)
+    }
+
+
+def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
+                      eos: int | None = None, page_size: int = 16,
+                      chunk: int | None = None,
+                      num_pages: int | None = None):
+    """Paged-KV continuous-batching engine (requires the quantized fused
+    layout, like :func:`make_batch_engine`). Defaults size the pool to
+    EXACTLY the dense engine's 4-slot HBM footprint (4 * max_seq KV
+    rows per layer, null page included) — the paged engine runs
+    ``max_slots`` streams inside it because pages are granted for
+    actual context, not worst-case."""
+    from dora_tpu.models import vlm as _vlm
+    from dora_tpu.models.batch_engine import PagedBatchEngine
+
+    assert _vlm.fused_batch_ready(params), (
+        "paged engine needs quantize_decode params (DORA_INT8_DECODE / "
+        "DORA_INT4_DECODE)"
+    )
+    chunk = chunk or min(256, cfg.max_seq)
+    if num_pages is None:
+        num_pages = 4 * cfg.max_seq // page_size
+    step = jax.jit(
+        lambda tokens, pools, positions, bts: fused_paged_batch_step(
+            params, cfg, tokens, pools, positions, bts
+        ),
+        donate_argnums=(1,),
+    )
+    chunk_fn = jax.jit(
+        lambda ids, pools, position, bt: fused_paged_chunk_step(
+            params, cfg, ids, pools, position, bt
+        ),
+        donate_argnums=(1,),
+    )
+    return PagedBatchEngine(
+        init_pool=lambda n: init_page_pool(cfg, n, page_size),
+        chunk_prefill=chunk_fn,
+        batch_step=step,
+        max_slots=max_slots,
+        max_seq=cfg.max_seq,
+        page_size=page_size,
+        chunk=chunk,
+        num_pages=num_pages,
+        eos=eos,
+    )
+
+
 def _lm(params, cfg: Qwen2Config, h, positions, mask, caches=None, cache_index=None):
     rope = L.rope_table(cfg.max_seq, cfg.head_dim, base=cfg.rope_theta)
     new_caches = {}

@@ -489,6 +489,61 @@ def fused_decode_pass_batch(params, x, caches, positions, cos_rows,
     )
 
 
+def fused_paged_pass_batch(params, x, pools, positions, block_tables,
+                           cos_rows, sin_rows, *, heads: int, kv_heads: int,
+                           head_dim: int, layers: int, eps: float = 1e-6):
+    """Batched fused pass over PAGED KV pools: per-layer K/V live as a
+    pool of [P, KV, page, hd] blocks and each row's context streams
+    through its ``block_tables`` row instead of a contiguous
+    [slot, max_seq] plane (ops.decode_block.attention_paged_batch_step).
+    Same per-row math as :func:`fused_decode_pass_batch` — the paged
+    engine's greedy tokens stay identical to the dense engine's."""
+    from dora_tpu.ops import decode_block as DB
+
+    def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        x, kp, vp = DB.attention_paged_batch_step(
+            x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+            pools[str(i)]["k"], pools[str(i)]["v"], wo, swo, positions,
+            block_tables,
+            heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
+        )
+        return x, {"k": kp, "v": vp}
+
+    return _fused_pass(
+        params, x, attn_apply, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, layers=layers, eps=eps,
+    )
+
+
+def fused_paged_pass_chunk(params, x, pools, position, block_table,
+                           cos_rows, sin_rows, *, heads: int, kv_heads: int,
+                           head_dim: int, layers: int, eps: float = 1e-6):
+    """One prefill CHUNK through the fused kernels into paged pools:
+    x [M, dim] holds the chunk's embedded tokens at positions
+    ``position..position+M-1`` (``position`` and M page-multiples — the
+    chunk's K/V land as whole pool pages through this slot's
+    ``block_table`` row). M is fixed by the engine, so prefill compiles
+    exactly one chunk shape — ever — instead of one program per
+    power-of-two bucket. Returns (greedy [M], pools); greedy[i]
+    continues the prefix through row i, so the final chunk's row at
+    ``true_len - 1 - position`` is the stream's first generated token."""
+    from dora_tpu.ops import decode_block as DB
+
+    def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        x, kp, vp = DB.attention_paged_chunk_step(
+            x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+            pools[str(i)]["k"], pools[str(i)]["v"], wo, swo, position,
+            block_table,
+            heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
+        )
+        return x, {"k": kp, "v": vp}
+
+    return _fused_pass(
+        params, x, attn_apply, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, layers=layers, eps=eps,
+    )
+
+
 def generate_tp(params, tp_params, cfg: VLMConfig, images, prompt_ids,
                 max_new_tokens: int, mesh):
     """Greedy generation with the decode scan on the FUSED kernel tier

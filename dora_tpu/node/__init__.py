@@ -371,6 +371,14 @@ class Node:
         unless coalescing is enabled (``DORA_SEND_COALESCE``)."""
         self._control.flush()
 
+    def report_serving(self, snapshot: dict) -> None:
+        """Ship a serving-metrics snapshot (metrics.ServingMetrics.
+        snapshot()) to the daemon, fire-and-forget on the control
+        channel — the metrics plane's node-side entry point (serving
+        nodes call this periodically; see nodehub/llm_server)."""
+        self._control.queue(n2d.ReportServing(snapshot=dict(snapshot)))
+        self._control.flush()
+
     def allocate_sample(self, size: int) -> "DataSample":
         """Allocate a writable sample backed by a shared-memory region
         (reference: allocate_data_sample + DataSample,

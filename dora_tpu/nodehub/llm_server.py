@@ -4,20 +4,37 @@ Reference parity: node-hub/dora-openai-server pairs with ONE llm node
 that answers one request at a time (openai-proxy-server/src/main.rs:
 30-50 — requests serialize through the dataflow). This node batches:
 every ``text`` input carrying a ``request_id`` is admitted into a
-models/batch_engine.BatchEngine slot, and each engine step advances ALL
-active requests one token off a single LM weight stream (the batched
-fused kernels, ops/decode_block.attention_batch_step). Token deltas
-stream back on ``response`` tagged ``{request_id, done}`` — the
-openai_server's concurrent mode routes them to the right SSE stream.
+serving-engine slot, and each engine step advances ALL active requests
+one token off a single LM weight stream (the batched fused kernels,
+ops/decode_block). Token deltas stream back on ``response`` tagged
+``{request_id, done}`` — the openai_server's concurrent mode routes
+them to the right SSE stream.
+
+Two engines (models/batch_engine.py):
+
+* PAGED (default): KV lives in a pool of page-size blocks routed
+  through per-slot block tables, prompts prefill in fixed-shape chunks
+  interleaved with decode — concurrency scales with actual context
+  held, long prompts don't stall active streams, and admission is
+  page-aware (a request is admitted only while free pages cover
+  prompt + max_new, so an admitted stream can never OOM mid-decode).
+* DENSE (``DORA_PAGED_KV=0``): the round-5 `[slots, …, max_seq]` plane
+  with synchronous bucket prefill.
 
 Model: a Qwen2-family checkpoint from ``DORA_HF_CHECKPOINT`` (quantized
 into the fused decode layout — int8 by default, DORA_INT4_DECODE=1 for
 int4); without a checkpoint the node refuses loudly (a chat server with
 random weights helps nobody).
 
-Env: DORA_BATCH_SLOTS (default 4) concurrent streams;
+Env: DORA_BATCH_SLOTS (default 16 paged / 4 dense) concurrent streams;
 DORA_MAX_NEW_TOKENS (default 32) per-request cap (a request's
-``max_tokens`` lowers it); DORA_MAX_SEQ cache length.
+``max_tokens`` lowers it); DORA_MAX_SEQ cache length; DORA_PAGE_SIZE
+(default 16) KV rows per page; DORA_PREFILL_CHUNK prefill chunk rows
+(default min(256, max_seq)); DORA_PAGED_KV=0 for the dense engine.
+
+Serving metrics (slots, free pages, backlog, decode tokens/s, TTFT
+histogram) ship to the daemon every second and surface in
+``dora-tpu metrics [--watch]``.
 
 Dataflow usage::
 
@@ -30,13 +47,36 @@ Dataflow usage::
 from __future__ import annotations
 
 import os
+import time
 
 import pyarrow as pa
 
 from dora_tpu.node import Node
 
 
+def make_engine(params, cfg, eos=None):
+    """Build the serving engine from the env knobs (paged by default)."""
+    from dora_tpu.models.hf import qwen2
+
+    paged = os.environ.get("DORA_PAGED_KV", "1") != "0"
+    slots = int(
+        os.environ.get("DORA_BATCH_SLOTS", "16" if paged else "4")
+    )
+    if not paged:
+        return qwen2.make_batch_engine(
+            params, cfg, max_slots=slots, eos=eos
+        )
+    page_size = int(os.environ.get("DORA_PAGE_SIZE", "16"))
+    chunk_env = os.environ.get("DORA_PREFILL_CHUNK")
+    chunk = int(chunk_env) if chunk_env else None
+    return qwen2.make_paged_engine(
+        params, cfg, max_slots=slots, eos=eos, page_size=page_size,
+        chunk=chunk,
+    )
+
+
 def main() -> None:
+    from dora_tpu.metrics import ServingMetrics
     from dora_tpu.models.hf import qwen2
 
     path = os.environ.get("DORA_HF_CHECKPOINT")
@@ -47,7 +87,6 @@ def main() -> None:
         )
     max_seq = int(os.environ.get("DORA_MAX_SEQ", "2048"))
     max_new_cap = int(os.environ.get("DORA_MAX_NEW_TOKENS", "32"))
-    slots = int(os.environ.get("DORA_BATCH_SLOTS", "4"))
 
     cfg, params = qwen2.load(path, max_seq=max_seq)
     if not os.environ.get("DORA_INT8_DECODE") and not os.environ.get(
@@ -80,17 +119,23 @@ def main() -> None:
 
         return tokenizer.decode([token])
 
-    engine = qwen2.make_batch_engine(params, cfg, max_slots=slots, eos=eos)
+    engine = make_engine(params, cfg, eos=eos)
+    paged = hasattr(engine, "free_pages")
+    metrics = ServingMetrics(engine="paged" if paged else "dense")
     node = Node()
-    #: requests that arrived while every slot was busy (FIFO admission;
-    #: only length-admissible requests ever enter, so a freed slot can
-    #: always take the head)
+    #: requests that arrived while the engine couldn't admit them
+    #: (FIFO admission; only fits()-admissible requests ever enter, so
+    #: freed slots/pages can always eventually take the head)
     backlog: list[tuple[str, list[int], int]] = []
-    #: engine key -> wire request_id (None for untagged requests from
-    #: the serial openai_server mode, whose chunks must carry NO
-    #: request_id so the server's legacy queue receives them)
+    #: engine key -> wire request_id. The ENGINE key is always unique
+    #: (req-N): two in-flight requests carrying the same wire
+    #: ``request_id`` must not share a slot key, or their token streams
+    #: silently interleave — the wire id is carried separately and only
+    #: stamped on the outgoing chunks.
     wire_ids: dict[str, str | None] = {}
-    anon_counter = [0]
+    #: engine key -> admission wall time, pending first token (TTFT)
+    t_admitted: dict[str, float] = {}
+    req_counter = [0]
 
     def emit_text(
         key: str, text: str, done: bool, finish: str | None = None
@@ -103,6 +148,9 @@ def main() -> None:
         rid = wire_ids.get(key)
         if rid is not None:
             meta["request_id"] = rid
+        t0 = t_admitted.pop(key, None)
+        if t0 is not None:
+            metrics.ttft.observe((time.monotonic() - t0) * 1e6)
         node.send_output("response", pa.array([text]), meta)
         if done:
             wire_ids.pop(key, None)
@@ -111,15 +159,37 @@ def main() -> None:
         finish = None
         if done:
             finish = "stop" if (eos is not None and token == eos) else "length"
+        metrics.decode_tokens += 1
         emit_text(key, decode_one(token), done, finish)
 
     def start(key: str, ids: list[int], max_new: int) -> None:
-        token, done = engine.submit(key, ids, max_new)
-        emit(key, token, done)
+        res = engine.submit(key, ids, max_new)
+        if res is not None:  # dense engine: first token is synchronous
+            emit(key, *res)
+        # paged engine: submit queues the prefill; the first token is
+        # emitted by a later step() when the final chunk lands.
 
     def admit_backlog() -> None:
-        while backlog and engine.free_slots:
+        while backlog and engine.can_admit(
+            len(backlog[0][1]), backlog[0][2]
+        ):
             start(*backlog.pop(0))
+
+    def report(now: float) -> None:
+        metrics.slots_active = engine.active
+        metrics.slots_total = engine.max_slots
+        metrics.backlog_depth = len(backlog)
+        metrics.prefill_chunks = getattr(engine, "chunks_run", 0)
+        if paged:
+            metrics.free_pages = engine.free_pages
+            metrics.total_pages = engine.allocator.num_pages - 1
+        try:
+            node.report_serving(metrics.snapshot())
+        except Exception:
+            pass  # metrics are best-effort; serving never blocks on them
+        report.last = now
+
+    report.last = time.monotonic()
 
     try:
         while True:
@@ -140,9 +210,10 @@ def main() -> None:
                         if isinstance(value, pa.Array)
                         else bytes(value or b"").decode(errors="replace")
                     )
-                    anon_counter[0] += 1
-                    key = rid if rid is not None else f"anon-{anon_counter[0]}"
+                    req_counter[0] += 1
+                    key = f"req-{req_counter[0]}"
                     wire_ids[key] = rid
+                    metrics.requests += 1
                     ids = encode(text) or [0]
                     max_new = min(
                         int(meta.get("max_new_tokens", max_new_cap)),
@@ -151,19 +222,27 @@ def main() -> None:
                     if max_new <= 0:
                         # max_tokens <= 0 asks for nothing: close the
                         # stream empty instead of fabricating a token.
+                        metrics.rejected += 1
                         emit_text(key, "", True, finish="length")
                     elif not engine.fits(len(ids), max_new):
                         # Oversized: close the stream empty — never
                         # fabricate a token as a "successful" answer.
+                        metrics.rejected += 1
                         emit_text(key, "", True, finish="length")
-                    elif not engine.free_slots:
+                    elif not engine.can_admit(len(ids), max_new):
+                        t_admitted[key] = time.monotonic()
                         backlog.append((key, ids, max_new))
                     else:
+                        t_admitted[key] = time.monotonic()
                         start(key, ids, max_new)
             for key, token, done in engine.step():
                 emit(key, token, done)
             admit_backlog()
+            now = time.monotonic()
+            if now - report.last >= 1.0:
+                report(now)
     finally:
+        report(time.monotonic())
         node.close()
 
 

@@ -44,6 +44,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept both
+# so the kernel tier runs on every toolchain the container may carry.
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 
 def _interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
@@ -864,6 +869,507 @@ def attention_batch_step(
         jnp.asarray(positions, jnp.int32).reshape(batch),
         x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
         cos_rows, sin_rows, k_caches, v_caches, wo, swo,
+    )
+
+
+# ---------------------------------------------------------------------------
+# paged attention (block-table KV: concurrency decoupled from max_seq)
+# ---------------------------------------------------------------------------
+#
+# The dense batched kernel above streams each row's K/V from a private
+# contiguous [slot, max_seq] plane, so HBM cost is max_slots * max_seq
+# rows whether a slot holds 40 tokens or 2000. The paged tier keeps ONE
+# fixed pool of page-size blocks shared by every slot; a per-slot block
+# table maps logical page j to a physical pool page, so HBM scales with
+# tokens actually held (vLLM's PagedAttention insight). Physical page 0
+# is reserved as the idle dump: inactive rows point at it and their
+# position-0 writes land there harmlessly.
+
+
+def _attn_paged_batch_kernel(
+    pos_ref,  # SMEM (B,) int32 — per-row positions
+    bt_ref,   # SMEM (B, max_pages) int32 — per-row block tables
+    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+    kp_in, vp_in, wo_ref, swo_ref,
+    out_ref, kp_out, vp_out,
+    kv_row, kblk, vblk, sem, wsem,
+    *, heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
+    batch: int, residual: bool,
+):
+    """B-row decode over B independent sequences whose K/V live in a
+    shared page pool [P, KV, page, hd]. Identical math to
+    :func:`_attn_batch_kernel`; only the HBM addressing changes — the
+    flash sweep walks pool pages through the row's block table, and the
+    in-place row write targets the row's CURRENT page."""
+    half = head_dim // 2
+    dtype = x_ref.dtype
+    int4 = wqkv_ref.dtype == jnp.uint8
+    group = heads // kv_heads
+    scale = 1.0 / (head_dim ** 0.5)
+
+    # --- projections (all rows at once: one weight pass) --------------------
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [B, D]
+    qkv = _wdot(h, wqkv_ref, sqkv_ref[...], int4=int4) + bqkv_ref[...].astype(
+        jnp.float32
+    )
+    cos_b = cos_ref[...].astype(jnp.float32)
+    sin_b = sin_ref[...].astype(jnp.float32)
+
+    qf = qkv[:, : heads * head_dim].reshape(batch * heads, head_dim)
+    kf = qkv[:, heads * head_dim : (heads + kv_heads) * head_dim].reshape(
+        batch * kv_heads, head_dim
+    )
+    vf = qkv[:, (heads + kv_heads) * head_dim :].reshape(
+        batch * kv_heads, head_dim
+    )
+
+    def _expand(t, reps):
+        return jnp.broadcast_to(
+            t[:, None, :], (batch, reps, head_dim)
+        ).reshape(batch * reps, head_dim)
+
+    q = _rotate(qf, _expand(cos_b, heads), _expand(sin_b, heads), half)
+    k = _rotate(kf, _expand(cos_b, kv_heads), _expand(sin_b, kv_heads), half)
+    q_b = q.reshape(batch, heads, head_dim)
+    k_b = k.reshape(batch, kv_heads, head_dim)
+    v_b = vf.reshape(batch, kv_heads, head_dim)
+
+    # --- per-row cache RMW into the row's current page ----------------------
+    # Same aligned 8-row read-modify-write as the dense kernel, but the
+    # window lives inside pool page bt[b, pos // page] at in-page offset
+    # pos % page (page is a multiple of 8, so the window never crosses a
+    # page boundary).
+    pending = []
+    for b in range(batch):
+        pos = pos_ref[b]
+        cur = bt_ref[b, pos // page]
+        inpage = pos - pos // page * page
+        aligned = pl.multiple_of(inpage // 8 * 8, 8)
+        rd_k = pltpu.make_async_copy(
+            kp_out.at[cur, :, pl.ds(aligned, 8), :], kv_row.at[0, b],
+            sem.at[0],
+        )
+        rd_v = pltpu.make_async_copy(
+            vp_out.at[cur, :, pl.ds(aligned, 8), :], kv_row.at[1, b],
+            sem.at[1],
+        )
+        rd_k.start()
+        rd_v.start()
+        rd_k.wait()
+        rd_v.wait()
+        row_sel = (
+            jax.lax.broadcasted_iota(jnp.int32, (kv_heads, 8, head_dim), 1)
+            == inpage - aligned
+        )
+        kv_row[0, b] = jnp.where(
+            row_sel, k_b[b][:, None, :].astype(kv_row.dtype), kv_row[0, b]
+        )
+        kv_row[1, b] = jnp.where(
+            row_sel, v_b[b][:, None, :].astype(kv_row.dtype), kv_row[1, b]
+        )
+        wr_k = pltpu.make_async_copy(
+            kv_row.at[0, b], kp_out.at[cur, :, pl.ds(aligned, 8), :],
+            wsem.at[0, b],
+        )
+        wr_v = pltpu.make_async_copy(
+            kv_row.at[1, b], vp_out.at[cur, :, pl.ds(aligned, 8), :],
+            wsem.at[1, b],
+        )
+        wr_k.start()
+        wr_v.start()
+        pending += [wr_k, wr_v]
+
+    # --- per-row flash sweep: pool pages through the block table ------------
+    attn_rows = []
+    for b in range(batch):
+        pos = pos_ref[b]
+        nblocks = (pos + page - 1) // page  # prior context, incl. partial page
+        qb = q_b[b]
+
+        def body(blk, carry, pos=pos, qb=qb, b=b):
+            m_run, l_run, acc = carry
+            pg = bt_ref[b, blk]
+            kcp = pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2])
+            vcp = pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3])
+            kcp.start()
+            vcp.start()
+            kcp.wait()
+            vcp.wait()
+            live = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) + blk * page
+            ) < pos
+            scores = []
+            for g in range(kv_heads):
+                s_g = jax.lax.dot_general(
+                    qb[g * group : (g + 1) * group].astype(dtype),
+                    kblk[g].astype(dtype),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                scores.append(s_g)
+            s = jnp.concatenate(scores, axis=0) * scale
+            s = jnp.where(live, s, -jnp.inf)
+            m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_run - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = []
+            for g in range(kv_heads):
+                pv.append(
+                    jax.lax.dot(
+                        p[g * group : (g + 1) * group].astype(dtype),
+                        vblk[g].astype(dtype),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            acc_new = acc * alpha + jnp.concatenate(pv, axis=0)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((heads, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((heads, 1), jnp.float32)
+        a0 = jnp.zeros((heads, head_dim), jnp.float32)
+        m_fin, l_fin, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, a0))
+
+        # fold in the current position from registers (exact merge)
+        q3 = qb.reshape(kv_heads, group, head_dim)
+        s_new = (
+            jnp.sum(q3 * k_b[b][:, None, :], axis=-1).reshape(heads, 1)
+            * scale
+        )
+        m2 = jnp.maximum(m_fin, s_new)
+        alpha = jnp.exp(m_fin - m2)
+        w_new = jnp.exp(s_new - m2)
+        l2 = l_fin * alpha + w_new
+        v_full = jnp.broadcast_to(
+            v_b[b][:, None, :], (kv_heads, group, head_dim)
+        ).reshape(heads, head_dim)
+        attn_rows.append((acc * alpha + w_new * v_full) / l2)
+
+    attn = jnp.stack(attn_rows, axis=0).reshape(batch, heads * head_dim)
+
+    # --- output projection + residual ---------------------------------------
+    o = _wdot(attn.astype(dtype), wo_ref, swo_ref[...], int4=int4)
+    if residual:
+        o = x_ref[...].astype(jnp.float32) + o
+    out_ref[...] = o.astype(out_ref.dtype)
+    for copy in pending:
+        copy.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("heads", "kv_heads", "head_dim", "eps", "residual"),
+)
+def attention_paged_batch_step(
+    x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_pool, v_pool,
+    wo, swo, positions, block_tables, *, heads: int, kv_heads: int,
+    head_dim: int, eps: float = 1e-6, residual: bool = True,
+):
+    """Fused paged decode attention for B independent sequences.
+
+    x: [B, D]; pools: [P, KV, page, hd] shared blocks (updated in place
+    at each row's ``positions[b]`` inside page
+    ``block_tables[b, positions[b] // page]``); block_tables:
+    [B, max_pages] int32 physical page ids (0 = the reserved idle page).
+    Weight layout matches :func:`attention_batch_step`. Returns
+    (x_out [B, D], k_pool, v_pool).
+    """
+    batch = x.shape[0]
+    page = k_pool.shape[2]
+    assert page % 8 == 0, page
+    d = x.shape[-1]
+    n_qkv = wqkv.shape[1]
+    kernel = functools.partial(
+        _attn_paged_batch_kernel, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, page=page, eps=eps, batch=batch,
+        residual=residual,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # norm_w
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
+            pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, batch, kv_heads, 8, head_dim), k_pool.dtype),
+            pltpu.VMEM((kv_heads, page, head_dim), k_pool.dtype),
+            pltpu.VMEM((kv_heads, page, head_dim), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SemaphoreType.DMA((2, batch)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (batch, d), x.dtype if residual else jnp.float32
+            ),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        # positional arg i (0-based, INCLUDING the 2 scalar prefetches)
+        # -> output j: pools update in place.
+        input_output_aliases={9: 1, 10: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        jnp.asarray(positions, jnp.int32).reshape(batch),
+        jnp.asarray(block_tables, jnp.int32),
+        x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
+        cos_rows, sin_rows, k_pool, v_pool, wo, swo,
+    )
+
+
+def _attn_paged_chunk_kernel(
+    pos_ref,  # SMEM (1,) int32 — chunk start (multiple of page)
+    bt_ref,   # SMEM (max_pages,) int32 — this slot's block table
+    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+    kp_in, vp_in, wo_ref, swo_ref,
+    out_ref, kp_out, vp_out,
+    kv_win, kblk, vblk, sem, wsem,
+    *, heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
+    m: int, residual: bool,
+):
+    """M-row chunked-prefill step for ONE slot: rows occupy positions
+    pos..pos+m-1, attend the prior paged context (idx < pos, streamed
+    through the block table) plus each other causally from registers.
+    ``pos`` and ``m`` are multiples of ``page``, so the chunk's K/V
+    write covers m/page WHOLE pool pages — no read-modify-write."""
+    pos = pos_ref[0]
+    half = head_dim // 2
+    dtype = x_ref.dtype
+    group = heads // kv_heads
+    scale = 1.0 / (head_dim ** 0.5)
+    int4 = wqkv_ref.dtype == jnp.uint8
+
+    # --- projections --------------------------------------------------------
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [M, D]
+    qkv = _wdot(h, wqkv_ref, sqkv_ref[...], int4=int4) + bqkv_ref[...].astype(
+        jnp.float32
+    )
+    qf = qkv[:, : heads * head_dim].reshape(m * heads, head_dim)
+    kf = qkv[:, heads * head_dim : (heads + kv_heads) * head_dim].reshape(
+        m * kv_heads, head_dim
+    )
+    vf = qkv[:, (heads + kv_heads) * head_dim :].reshape(
+        m * kv_heads, head_dim
+    )
+
+    cos_m = cos_ref[...].astype(jnp.float32)  # [M, hd] per-row tables
+    sin_m = sin_ref[...].astype(jnp.float32)
+
+    def _expand(t, reps):
+        return jnp.broadcast_to(
+            t[:, None, :], (m, reps, head_dim)
+        ).reshape(m * reps, head_dim)
+
+    q = _rotate(qf, _expand(cos_m, heads), _expand(sin_m, heads), half)
+    k = _rotate(kf, _expand(cos_m, kv_heads), _expand(sin_m, kv_heads), half)
+    k_m = k.reshape(m, kv_heads, head_dim)
+    v_m = vf.reshape(m, kv_heads, head_dim)
+
+    # --- whole-page chunk write (overlapped with the sweep) -----------------
+    kv_win[0] = k_m.transpose(1, 0, 2).astype(kv_win.dtype)  # [KV, M, hd]
+    kv_win[1] = v_m.transpose(1, 0, 2).astype(kv_win.dtype)
+    pending = []
+    for j in range(m // page):
+        pg = bt_ref[pos // page + j]
+        wr_k = pltpu.make_async_copy(
+            kv_win.at[0, :, pl.ds(j * page, page), :], kp_out.at[pg],
+            wsem.at[0, j],
+        )
+        wr_v = pltpu.make_async_copy(
+            kv_win.at[1, :, pl.ds(j * page, page), :], vp_out.at[pg],
+            wsem.at[1, j],
+        )
+        wr_k.start()
+        wr_v.start()
+        pending += [wr_k, wr_v]
+
+    # --- flash sweep over the prior paged context (idx < pos) ---------------
+    nblocks = pos // page  # pos is page-aligned: all prior pages are full
+    rows = m * group  # per kv head
+
+    def body(blk, carry):
+        m_run, l_run, acc = carry
+        pg = bt_ref[blk]
+        kcp = pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2])
+        vcp = pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3])
+        kcp.start()
+        vcp.start()
+        kcp.wait()
+        vcp.wait()
+        q4 = q.reshape(m, heads, head_dim)
+        outs = []
+        for g in range(kv_heads):
+            q_g = q4[:, g * group : (g + 1) * group, :].reshape(
+                rows, head_dim
+            )
+            s_g = jax.lax.dot_general(
+                q_g.astype(dtype), kblk[g].astype(dtype),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [rows, page]
+            outs.append(s_g)
+        s = jnp.concatenate(outs, axis=0)  # [KV*rows, page]
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = []
+        for g in range(kv_heads):
+            pv.append(
+                jax.lax.dot(
+                    p[g * rows : (g + 1) * rows].astype(dtype),
+                    vblk[g].astype(dtype),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        acc_new = acc * alpha + jnp.concatenate(pv, axis=0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((kv_heads * rows, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((kv_heads * rows, 1), jnp.float32)
+    a0 = jnp.zeros((kv_heads * rows, head_dim), jnp.float32)
+    m_fin, l_fin, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, a0))
+
+    # --- within-chunk causal attention from registers -----------------------
+    q4 = q.reshape(m, heads, head_dim)
+    causal = (
+        jax.lax.broadcasted_iota(jnp.int32, (rows, m), 0) // group
+        >= jax.lax.broadcasted_iota(jnp.int32, (rows, m), 1)
+    )
+    s_parts = []
+    for g in range(kv_heads):
+        q_g = q4[:, g * group : (g + 1) * group, :].reshape(rows, head_dim)
+        s_cc = jax.lax.dot_general(
+            q_g.astype(dtype), k_m[:, g, :].astype(dtype),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [rows, m]
+        s_parts.append(jnp.where(causal, s_cc, -jnp.inf))
+    s_cc = jnp.concatenate(s_parts, axis=0)  # [KV*rows, m]
+    m2 = jnp.maximum(m_fin, jnp.max(s_cc, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_fin - m2)
+    p_cc = jnp.exp(s_cc - m2)
+    l2 = l_fin * alpha + jnp.sum(p_cc, axis=-1, keepdims=True)
+    pv = []
+    for g in range(kv_heads):
+        pv.append(
+            jax.lax.dot(
+                p_cc[g * rows : (g + 1) * rows].astype(dtype),
+                v_m[:, g, :].astype(dtype),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    acc = acc * alpha + jnp.concatenate(pv, axis=0)
+    attn = acc / l2  # [KV*rows, hd], rows ordered (g, i, gg)
+
+    attn = (
+        attn.reshape(kv_heads, m, group, head_dim)
+        .transpose(1, 0, 2, 3)
+        .reshape(m, heads * head_dim)
+    )
+    o = _wdot(attn.astype(dtype), wo_ref, swo_ref[...], int4=int4)
+    if residual:
+        o = x_ref[...].astype(jnp.float32) + o
+    out_ref[...] = o.astype(out_ref.dtype)
+    for copy in pending:
+        copy.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("heads", "kv_heads", "head_dim", "eps", "residual"),
+)
+def attention_paged_chunk_step(
+    x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_pool, v_pool,
+    wo, swo, position, block_table, *, heads: int, kv_heads: int,
+    head_dim: int, eps: float = 1e-6, residual: bool = True,
+):
+    """M-row paged attention sublayer (chunked prefill).
+
+    x: [M, D] — the chunk's tokens at positions ``position..position+M-1``
+    where ``position`` and M are multiples of the pool page size;
+    block_table: [max_pages] int32 for THIS slot. The chunk's K/V land as
+    whole pool pages; prior context streams through the table. Returns
+    (x_out [M, D], k_pool, v_pool).
+    """
+    m, d = x.shape
+    page = k_pool.shape[2]
+    assert page % 8 == 0 and m % page == 0, (m, page)
+    n_qkv = wqkv.shape[1]
+    kernel = functools.partial(
+        _attn_paged_chunk_kernel, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, page=page, eps=eps, m=m, residual=residual,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # norm_w
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
+            pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, kv_heads, m, head_dim), k_pool.dtype),  # kv_win
+            pltpu.VMEM((kv_heads, page, head_dim), k_pool.dtype),
+            pltpu.VMEM((kv_heads, page, head_dim), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SemaphoreType.DMA((2, m // page)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (m, d), x.dtype if residual else jnp.float32
+            ),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        input_output_aliases={9: 1, 10: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        jnp.asarray([position], jnp.int32).reshape(1),
+        jnp.asarray(block_table, jnp.int32),
+        x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
+        cos_rows, sin_rows, k_pool, v_pool, wo, swo,
     )
 
 

@@ -38,6 +38,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 BLOCK_Q = 128
 BLOCK_K = 256
 LANE = 128

@@ -34,6 +34,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 _SUBLANE = 16  # bf16 sublane; f32's 8 divides it
 _LANE = 128
 

@@ -1,0 +1,328 @@
+"""Paged KV engine (models/batch_engine.PagedBatchEngine).
+
+The load-bearing properties:
+
+* TOKEN IDENTITY: the paged + chunked-prefill engine emits exactly the
+  greedy tokens the dense engine (and the serial batch-1 path) emits,
+  across staggered multi-slot admissions including prompts longer than
+  one prefill chunk — block-table indirection and chunk interleaving
+  change WHERE the KV rows live and WHEN prefill work runs, never the
+  math.
+* CAPACITY: 16 concurrent slots run inside exactly the HBM pool the
+  dense engine spends on 4 (pages are granted for actual context).
+* COMPILE COUNT: steady-state serving (admissions at varied prompt
+  lengths + decode steps) triggers ZERO new XLA compiles after warmup,
+  and chunked prefill compiles exactly one chunk shape — the dense
+  engine's per-bucket compile zoo is gone.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+#: every XLA backend compile observed in this process (the jax-internal
+#: monitoring event fires once per backend_compile; registered at import
+#: so warmup compiles are counted too)
+_COMPILE_EVENTS: list[str] = []
+
+
+def _register_compile_listener() -> None:
+    from jax._src import monitoring
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            _COMPILE_EVENTS.append(event)
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+_register_compile_listener()
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2(tmp_path_factory):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    config = Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = Qwen2ForCausalLM(config).eval()
+    path = tmp_path_factory.mktemp("qwen2-paged")
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+@pytest.fixture(scope="module")
+def quantized(tiny_qwen2):
+    import os
+
+    from dora_tpu.models.hf import qwen2
+
+    cfg, params = qwen2.load(tiny_qwen2, max_seq=64)
+    os.environ["DORA_INT8_DECODE"] = "1"
+    try:
+        qparams = qwen2.quantize_decode(params, cfg)
+    finally:
+        os.environ.pop("DORA_INT8_DECODE", None)
+    return cfg, qparams
+
+
+@pytest.fixture(scope="module")
+def serial_ref(quantized):
+    """Serial batch-1 greedy reference, cached per prompt tuple."""
+    import jax.numpy as jnp
+
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    cache: dict[tuple, list[int]] = {}
+
+    def ref(prompt: list[int], max_new: int) -> list[int]:
+        key = (tuple(prompt), max_new)
+        if key not in cache:
+            cache[key] = np.asarray(
+                qwen2.generate(
+                    qparams, cfg, jnp.asarray([prompt], jnp.int32), max_new
+                )
+            )[0].tolist()
+        return cache[key]
+
+    return ref
+
+
+def _drain(streams: dict, events) -> None:
+    for rid, token, _done in events:
+        streams[rid].append(token)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_reserves_null_page_and_is_all_or_nothing():
+    from dora_tpu.models.batch_engine import PageAllocator
+
+    a = PageAllocator(8)
+    assert a.free_pages == 7  # page 0 reserved
+    grant = a.alloc(7)
+    assert grant is not None and 0 not in grant
+    assert sorted(grant) == list(range(1, 8))
+    assert a.alloc(1) is None  # empty pool refuses
+    a.free(grant[:3])
+    assert a.free_pages == 3
+    assert a.alloc(4) is None  # all-or-nothing: no partial grant
+    assert a.free_pages == 3  # refused alloc takes nothing
+    assert sorted(a.alloc(3)) == sorted(grant[:3])
+
+
+def test_pages_needed_covers_chunk_padding():
+    from dora_tpu.models.batch_engine import PagedBatchEngine
+
+    e = PagedBatchEngine(
+        init_pool=lambda n: {}, chunk_prefill=None, batch_step=None,
+        max_slots=2, max_seq=64, page_size=8, chunk=16, num_pages=9,
+    )
+    # chunked prefill writes WHOLE pages: a 3-token prompt still burns a
+    # full 16-row chunk = 2 pages, even though 3+4 decode rows fit in 1
+    assert e.pages_needed(3, 4) == 2
+    # decode reach past the chunk padding is what sizes the grant
+    assert e.pages_needed(3, 30) == 5  # 33 rows -> ceil(33/8)
+    assert e.pages_needed(16, 4) == 3  # 20 rows beats the 16-row chunk
+    # fits() rejects never-admissible requests up front
+    assert not e.fits(60, 8)  # 68 rows > max_seq
+    assert e.fits(62, 2)  # 64 rows = 8 pages = the whole usable pool
+    # a second stream can't co-reside with a pool-filling one: admission
+    # is page-aware, not just slot-aware
+    e2 = PagedBatchEngine(
+        init_pool=lambda n: {}, chunk_prefill=None, batch_step=None,
+        max_slots=2, max_seq=64, page_size=8, chunk=16, num_pages=9,
+    )
+    e2.allocator.alloc(8)
+    assert e2.fits(3, 4) and not e2.can_admit(3, 4)
+
+
+# ---------------------------------------------------------------------------
+# token identity vs the dense engine + serial reference
+# ---------------------------------------------------------------------------
+
+
+def test_paged_matches_dense_across_staggered_admissions(
+    quantized, serial_ref
+):
+    """Staggered multi-slot admissions, including a 37-token prompt that
+    spans FIVE 8-token chunks admitted while other streams decode."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    rng = np.random.default_rng(5)
+    plens = (3, 7, 12, 37, 5)
+    prompts = [rng.integers(0, cfg.vocab, size=n).tolist() for n in plens]
+    max_new = 10
+
+    # Dense engine streams (the identity baseline).
+    dense = qwen2.make_batch_engine(qparams, cfg, max_slots=3)
+    dstreams: dict[str, list[int]] = {}
+    dstreams["r0"] = [dense.submit("r0", prompts[0], max_new)[0]]
+    _drain(dstreams, dense.step())
+    _drain(dstreams, dense.step())
+    dstreams["r1"] = [dense.submit("r1", prompts[1], max_new)[0]]
+    dstreams["r2"] = [dense.submit("r2", prompts[2], max_new)[0]]
+    while dense.free_slots == 0:
+        _drain(dstreams, dense.step())
+    dstreams["r3"] = [dense.submit("r3", prompts[3], max_new)[0]]
+    while dense.free_slots == 0:
+        _drain(dstreams, dense.step())
+    dstreams["r4"] = [dense.submit("r4", prompts[4], max_new)[0]]
+    while dense.active:
+        _drain(dstreams, dense.step())
+
+    # Paged engine, same prompts, admissions staggered mid-decode.
+    paged = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=5, page_size=8, chunk=8
+    )
+    pstreams: dict[str, list[int]] = {f"r{i}": [] for i in range(len(plens))}
+    paged.submit("r0", prompts[0], max_new)
+    for _ in range(3):
+        _drain(pstreams, paged.step())
+    paged.submit("r1", prompts[1], max_new)
+    paged.submit("r2", prompts[2], max_new)
+    _drain(pstreams, paged.step())
+    paged.submit("r3", prompts[3], max_new)  # 5-chunk prompt mid-flight
+    _drain(pstreams, paged.step())
+    paged.submit("r4", prompts[4], max_new)
+    for _ in range(300):
+        if not paged.active:
+            break
+        _drain(pstreams, paged.step())
+    assert paged.active == 0
+
+    for i in range(len(plens)):
+        rid = f"r{i}"
+        assert pstreams[rid] == dstreams[rid], (
+            f"paged stream {rid} diverged from dense"
+        )
+        assert pstreams[rid] == serial_ref(prompts[i], max_new), (
+            f"stream {rid} diverged from the serial reference"
+        )
+
+    # Every page returned to the allocator (no leaks across the run).
+    assert paged.free_pages == paged.allocator.num_pages - 1
+
+
+def test_16_slots_inside_the_dense_4_slot_footprint(quantized, serial_ref):
+    """4x the dense slot count in EXACTLY the dense engine's 4-slot KV
+    HBM: the default pool is 4 * max_seq rows per layer (null page
+    included), and 16 short streams decode concurrently inside it."""
+    import jax
+
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    paged = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=16, page_size=8, chunk=8
+    )
+    dense_caches = qwen2.init_cache(cfg, 4)
+    pool_bytes = sum(
+        leaf.nbytes for leaf in jax.tree.leaves(paged.pools)
+    )
+    dense_bytes = sum(
+        leaf.nbytes for leaf in jax.tree.leaves(dense_caches)
+    )
+    assert pool_bytes <= dense_bytes
+    assert paged.max_slots == 16
+
+    rng = np.random.default_rng(11)
+    base_prompts = [
+        rng.integers(0, cfg.vocab, size=n).tolist() for n in (3, 4, 2, 4)
+    ]
+    max_new = 4
+    streams: dict[str, list[int]] = {}
+    for i in range(16):
+        rid = f"s{i}"
+        streams[rid] = []
+        assert paged.can_admit(len(base_prompts[i % 4]), max_new)
+        paged.submit(rid, base_prompts[i % 4], max_new)
+    assert paged.active == 16  # all concurrent — dense caps at 4 here
+    for _ in range(200):
+        if not paged.active:
+            break
+        _drain(streams, paged.step())
+    assert paged.active == 0
+    for i in range(16):
+        want = serial_ref(base_prompts[i % 4], max_new)
+        assert streams[f"s{i}"] == want, f"stream s{i} diverged"
+    assert paged.free_pages == paged.allocator.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# compile-count regression guard
+# ---------------------------------------------------------------------------
+
+
+def test_steady_state_adds_zero_compiles_and_one_chunk_shape(quantized):
+    """After warmup, admissions at NEW prompt lengths plus decode steps
+    must not trigger a single XLA compile (positions, block tables and
+    chunk offsets are all traced operands), and the chunked-prefill jit
+    holds exactly ONE compiled shape — the dense engine's
+    one-compile-per-bucket zoo is structurally gone."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    engine = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=4, page_size=8, chunk=16
+    )
+    rng = np.random.default_rng(7)
+
+    def run(lengths: tuple[int, ...]) -> None:
+        streams: dict[str, list[int]] = {}
+        for i, n in enumerate(lengths):
+            rid = f"w{n}-{i}"
+            streams[rid] = []
+            while not engine.can_admit(n, 6):
+                _drain(streams, engine.step())
+            engine.submit(rid, rng.integers(0, cfg.vocab, size=n).tolist(), 6)
+            _drain(streams, engine.step())
+        for _ in range(200):
+            if not engine.active:
+                return
+            _drain(streams, engine.step())
+
+    run((3, 12, 20))  # warmup: single- and multi-chunk prompts
+    warm = len(_COMPILE_EVENTS)
+
+    run((5, 9, 17, 33, 2))  # five NEW lengths, staggered with decode
+    assert len(_COMPILE_EVENTS) == warm, (
+        f"steady-state serving compiled "
+        f"{len(_COMPILE_EVENTS) - warm} new XLA program(s)"
+    )
+    # Exactly one chunk shape ever: the prefill jit's cache holds one
+    # entry after serving prompt lengths from 2 to 33.
+    assert engine.chunk_prefill._cache_size() == 1
+    assert engine.batch_step._cache_size() == 1
+
+
+def test_dense_engine_mask_cached_across_unchanged_passes(quantized):
+    """Satellite: the dense engine no longer rebuilds the active-slot
+    mask / re-dispatches the position pin when membership is unchanged."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    engine = qwen2.make_batch_engine(qparams, cfg, max_slots=2)
+    engine.submit("a", [1, 2, 3], 8)
+    engine.step()  # membership changed by submit: rebuilds + pins
+    assert not engine._members_dirty
+    mask_obj = engine._mask
+    engine.step()
+    engine.step()
+    assert engine._mask is mask_obj  # cached, not rebuilt per pass
+    while engine.active:
+        engine.step()
+    assert engine._members_dirty  # freeing a slot invalidates the cache

@@ -1,0 +1,150 @@
+"""Serving metrics plane: node-side ServingMetrics -> ReportServing ->
+daemon -> coordinator QueryMetrics -> CLI SERVING table."""
+
+from __future__ import annotations
+
+import asyncio
+import textwrap
+
+import pytest
+
+from dora_tpu.coordinator import Coordinator
+from dora_tpu.daemon.core import Daemon
+from dora_tpu.message import coordinator as cm
+from dora_tpu.metrics import ServingMetrics, merge_snapshots
+
+
+def test_serving_snapshot_shape():
+    m = ServingMetrics(engine="paged")
+    m.requests = 3
+    m.decode_tokens = 40
+    m.prefill_chunks = 7
+    m.slots_active = 2
+    m.slots_total = 16
+    m.free_pages = 100
+    m.total_pages = 128
+    m.backlog_depth = 1
+    m.ttft.observe(2_500.0)
+    m.ttft.observe(9_000.0)
+    snap = m.snapshot()
+    assert snap["engine"] == "paged"
+    assert snap["decode_tokens"] == 40
+    assert snap["ttft_us"]["count"] == 2
+    assert snap["ttft_us"]["p50_us"] is not None
+
+
+def test_merge_unions_serving_across_daemons():
+    a = {"serving": {"llm": {"engine": "paged", "decode_tokens": 5}}}
+    b = {"serving": {"llm2": {"engine": "dense", "decode_tokens": 9}}}
+    merged = merge_snapshots([a, {}, b])
+    assert set(merged["serving"]) == {"llm", "llm2"}
+    assert merged["serving"]["llm"]["decode_tokens"] == 5
+    # no serving anywhere -> the key stays absent (CLI renders nothing)
+    assert "serving" not in merge_snapshots([{"links": {}}])
+
+
+def test_render_serving_table_with_rates():
+    from dora_tpu.cli.metrics_view import render_metrics
+
+    def snap(tokens: int) -> dict:
+        return {
+            "serving": {
+                "llm": {
+                    "engine": "paged",
+                    "requests": 4,
+                    "decode_tokens": tokens,
+                    "slots_active": 3,
+                    "slots_total": 16,
+                    "free_pages": 120,
+                    "total_pages": 128,
+                    "backlog_depth": 2,
+                    "ttft_us": {
+                        "count": 4, "p50_us": 2500.0, "p90_us": 8000.0,
+                        "p99_us": 9000.0,
+                    },
+                }
+            }
+        }
+
+    out = render_metrics("u", snap(150), prev=snap(50), interval=2.0)
+    assert "SERVING" in out and "llm (paged)" in out
+    assert "3/16" in out  # slots
+    assert "120/128" in out  # pages
+    assert "50.0" in out  # (150 - 50) / 2.0 tok/s
+    assert "2.5ms" in out  # ttft p50
+    one_shot = render_metrics("u", snap(150))
+    assert "llm (paged)" in one_shot  # renders without watch deltas too
+
+
+REPORTER = textwrap.dedent(
+    """
+    from dora_tpu.metrics import ServingMetrics
+    from dora_tpu.node import Node
+
+    node = Node()
+    m = ServingMetrics(engine="paged")
+    m.requests = 2
+    m.decode_tokens = 17
+    m.slots_active = 1
+    m.slots_total = 16
+    m.free_pages = 99
+    m.total_pages = 127
+    m.ttft.observe(1234.0)
+    node.report_serving(m.snapshot())
+    node.report_serving(m.snapshot())  # latest-wins, re-reports are fine
+    node.close()
+    """
+)
+
+
+def test_report_serving_reaches_query_metrics(tmp_path, monkeypatch):
+    monkeypatch.setenv("DORA_P2P", "0")
+    (tmp_path / "serving_reporter.py").write_text(REPORTER)
+    spec = {
+        "nodes": [
+            {"id": "llm", "path": "serving_reporter.py", "outputs": []},
+        ]
+    }
+
+    async def main():
+        from tests.test_metrics import _wait_finished, _wait_machines
+
+        coord = Coordinator()
+        await coord.start()
+        daemon = Daemon()
+        task = asyncio.create_task(
+            daemon.run(f"127.0.0.1:{coord.daemon_port}", "A")
+        )
+        try:
+            await _wait_machines(coord, {"A"})
+            start = await coord.handle_control_request(
+                cm.Start(
+                    dataflow=spec,
+                    name="served",
+                    local_working_dir=str(tmp_path),
+                )
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+            result = await _wait_finished(coord, start.uuid)
+            assert result.is_ok(), result.errors()
+            reply = await coord.handle_control_request(
+                cm.QueryMetrics(dataflow_uuid=start.uuid)
+            )
+            assert isinstance(reply, cm.MetricsReply), reply
+            serving = reply.metrics.get("serving")
+            assert serving is not None, reply.metrics
+            s = serving["llm"]
+            assert s["engine"] == "paged"
+            assert s["decode_tokens"] == 17
+            assert s["ttft_us"]["count"] == 1
+
+            from dora_tpu.cli.metrics_view import render_metrics
+
+            out = render_metrics(start.uuid, reply.metrics)
+            assert "llm (paged)" in out
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            task.cancel()
+            await coord.close()
+
+    asyncio.run(main())
