@@ -459,6 +459,43 @@ def serving_engine_ab() -> dict:
     return data
 
 
+def serving_multistep_ab() -> dict:
+    """K-sweep of the fused multi-step decode window
+    (tools/bench_serving --multistep): host round-trips — engine
+    dispatches + device->host fetches — per emitted token, and decode
+    tok/s, at K in {1, 4, 8, 16} for 4 and 16 streams. The headline is
+    ``k8_vs_k1_rt_reduction`` (the ≥4x amortization gate), a host-side
+    COUNT and therefore immune to the tunnel-drift caveat that clouds
+    wall-clock serving numbers (KNOWN_ISSUES round 4). Fresh subprocess
+    for the same accelerator-claim reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--multistep",
+        ],
+        capture_output=True, text=True, timeout=3600,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "multistep" in row:
+            data = row["multistep"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "k_sweep": None,
+            "k8_vs_k1_rt_reduction": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -612,6 +649,15 @@ def main() -> int:
         }
 
     try:
+        multistep_ab = serving_multistep_ab()
+    except Exception as exc:
+        multistep_ab = {
+            "k_sweep": None,
+            "k8_vs_k1_rt_reduction": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -644,6 +690,7 @@ def main() -> int:
         "recorder_ab": recorder_ab,
         "tracing_ab": tracing_ab,
         "serving_engine_ab": engine_ab,
+        "serving_multistep_ab": multistep_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

@@ -110,6 +110,7 @@ def render_metrics(
         for nid in sorted(serving):
             s = serving[nid]
             ttft = s.get("ttft_us", {})
+            gap = s.get("dispatch_gap_us", {})
             toks = s.get("decode_tokens", 0)
             if interval:
                 before = prev_serving.get(nid, {})
@@ -121,6 +122,7 @@ def render_metrics(
                 if s.get("total_pages")
                 else "-"
             )
+            tpd = s.get("tokens_per_dispatch")
             serving_rows.append([
                 f"{nid} ({s.get('engine', '?')})",
                 f"{s.get('slots_active', 0)}/{s.get('slots_total', 0)}",
@@ -128,13 +130,17 @@ def render_metrics(
                 str(s.get("backlog_depth", 0)),
                 str(toks),
                 tps,
+                f"{tpd:.1f}" if tpd is not None else "-",
                 _fmt_us(ttft.get("p50_us")),
                 _fmt_us(ttft.get("p99_us")),
+                _fmt_us(gap.get("p50_us")),
+                _fmt_us(gap.get("p99_us")),
                 str(s.get("requests", 0)),
             ])
         lines += [""] + _table(
             ["SERVING", "SLOTS", "PAGES", "BACKLOG", "TOKENS", "TOK/S",
-             "TTFT P50", "TTFT P99", "REQS"],
+             "TOK/DISP", "TTFT P50", "TTFT P99", "GAP P50", "GAP P99",
+             "REQS"],
             serving_rows,
         )
     return "\n".join(lines).rstrip() + "\n"

@@ -155,13 +155,18 @@ class ServingMetrics:
     """
 
     __slots__ = (
-        "ttft", "decode_tokens", "prefill_chunks", "requests", "rejected",
-        "slots_active", "slots_total", "free_pages", "total_pages",
-        "backlog_depth", "engine",
+        "ttft", "dispatch_gap", "decode_tokens", "prefill_chunks",
+        "requests", "rejected", "slots_active", "slots_total",
+        "free_pages", "total_pages", "backlog_depth", "host_dispatches",
+        "host_fetches", "engine",
     )
 
     def __init__(self, engine: str = "dense"):
         self.ttft = Histogram()
+        #: host time between consecutive engine dispatches while decode
+        #: is active — the per-step host overhead the multi-step window
+        #: amortizes (each gap now buys up to K tokens, not 1)
+        self.dispatch_gap = Histogram()
         self.decode_tokens = 0
         self.prefill_chunks = 0
         self.requests = 0
@@ -171,6 +176,10 @@ class ServingMetrics:
         self.free_pages = 0
         self.total_pages = 0
         self.backlog_depth = 0
+        #: device program launches / device->host fetches (engine
+        #: counters, set just before snapshot like the gauges)
+        self.host_dispatches = 0
+        self.host_fetches = 0
         self.engine = engine
 
     def snapshot(self) -> dict:
@@ -185,7 +194,15 @@ class ServingMetrics:
             "free_pages": self.free_pages,
             "total_pages": self.total_pages,
             "backlog_depth": self.backlog_depth,
+            "host_dispatches": self.host_dispatches,
+            "host_fetches": self.host_fetches,
+            "tokens_per_dispatch": (
+                round(self.decode_tokens / self.host_dispatches, 2)
+                if self.host_dispatches
+                else None
+            ),
             "ttft_us": self.ttft.snapshot(),
+            "dispatch_gap_us": self.dispatch_gap.snapshot(),
         }
 
 

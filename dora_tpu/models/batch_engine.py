@@ -88,6 +88,12 @@ class BatchEngine:
         self._mask = jnp.zeros((max_slots,), bool)
         self._imask = self._mask.astype(jnp.int32)
         self._members_dirty = True
+        #: host->device program launches / device->host token fetches
+        #: driven by this engine — the round-trip accounting behind the
+        #: serving tokens_per_dispatch metric (same fields as the paged
+        #: engine, so the scheduler reads either uniformly)
+        self.dispatches = 0
+        self.fetches = 0
 
     # -- admission -----------------------------------------------------------
 
@@ -135,9 +141,11 @@ class BatchEngine:
             self.caches, self.tokens, self.positions, caches_1, first,
             pos, b,
         )
+        self.dispatches += 1
         # Host-read AFTER the insert dispatch: the transfer then overlaps
         # the insert instead of fencing the device before it is queued.
         token = int(first[0])
+        self.fetches += 1
         done = (self.eos is not None and token == self.eos) or max_new <= 1
         if not done:
             self.slots[b] = _Slot(request_id, emitted=1, max_new=max_new)
@@ -172,12 +180,14 @@ class BatchEngine:
         nxt, self.caches = self.batch_step(
             self.tokens, self.caches, self.positions
         )
+        self.dispatches += 1
         self.tokens = nxt
         self.positions = self.positions + self._imask
         emitted = []
         import numpy as np
 
         host = np.asarray(nxt)  # ONE device->host transfer for all slots
+        self.fetches += 1
         for b, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -257,21 +267,36 @@ class PagedBatchEngine:
     prefill compiles exactly one XLA program ever, vs one per
     power-of-two bucket in the dense engine.
 
-    Greedy outputs are bit-identical to the dense engine: the paged
-    kernels run the same per-row math, only the cache indexing routes
-    through the block table (asserted in tests/test_paged_engine.py).
+    Decode runs at WINDOW granularity: each :meth:`step` launches ONE
+    fused K-tick program (``window_step``, models/vlm.make_paged_window
+    with ``k = window``) that detects per-stream completion on device
+    and freezes finished rows mid-window, then fetches one [B, K+1]
+    token matrix — host dispatch and device->host fetch cost amortize
+    over K emitted tokens instead of being paid per token. The host
+    unpacks the matrix honoring each stream's done offset (-1 marks
+    ticks past a row's completion) and frees slots/pages; scheduling
+    decisions — admissions, prefill interleave, backlog — happen only
+    at window boundaries. ``window=1`` is the per-token behavior.
+
+    Greedy outputs are bit-identical to the dense engine at every K:
+    the paged kernels run the same per-row math, only the cache
+    indexing routes through the block table, and the window carries
+    exactly the state the per-tick loop carried (asserted in
+    tests/test_paged_engine.py).
 
     Closures (see models/hf/qwen2.make_paged_engine):
       * ``init_pool(num_pages)`` -> pools pytree
       * ``chunk_prefill(ids [C], pools, position, bt_row)`` ->
         (greedy [C], pools)
-      * ``batch_step(tokens [B], pools, positions [B], bts [B, P])`` ->
-        (greedy [B], pools)
+      * ``window_step(tokens [B], pools, positions [B], bts [B, P],
+        active [B], emitted [B], max_new [B])`` ->
+        (mat [B, K+1], tokens, positions, active, emitted, pools)
     """
 
-    def __init__(self, *, init_pool, chunk_prefill, batch_step,
+    def __init__(self, *, init_pool, chunk_prefill, window_step,
                  max_slots: int = 16, max_seq: int, page_size: int,
-                 chunk: int, num_pages: int, eos: int | None = None):
+                 chunk: int, num_pages: int, eos: int | None = None,
+                 window: int = 8):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -279,6 +304,7 @@ class PagedBatchEngine:
         assert page_size % 8 == 0, page_size  # sublane-aligned RMW window
         assert chunk % page_size == 0, (chunk, page_size)
         assert max_seq % chunk == 0, (max_seq, chunk)
+        assert window >= 1, window
         self._jnp = jnp
         self._np = np
         self.max_slots = max_slots
@@ -287,7 +313,8 @@ class PagedBatchEngine:
         self.chunk = chunk
         self.eos = eos
         self.chunk_prefill = chunk_prefill
-        self.batch_step = batch_step
+        self.window_step = window_step
+        self.window = window
         self.max_pages = max_seq // page_size
         self.pools = init_pool(num_pages)
         self.allocator = PageAllocator(num_pages)
@@ -306,10 +333,20 @@ class PagedBatchEngine:
         self._decode = [False] * max_slots
         self._prefillq: deque[int] = deque()
         self._mask = jnp.zeros((max_slots,), bool)
-        self._imask = self._mask.astype(jnp.int32)
+        # Per-slot device vectors carried through the decode window:
+        # tokens emitted so far and the max_new cap — the window's
+        # on-device completion test. Rebuilt from the host slots only
+        # when membership changes (a window boundary); otherwise the
+        # window's returned state carries forward untouched.
+        self._emitted_dev = jnp.zeros((max_slots,), jnp.int32)
+        self._maxnew_dev = jnp.zeros((max_slots,), jnp.int32)
         self._members_dirty = True
         #: prefill chunks run (serving metrics)
         self.chunks_run = 0
+        #: host->device program launches / device->host token fetches
+        #: (round-trip accounting behind tokens_per_dispatch)
+        self.dispatches = 0
+        self.fetches = 0
 
         def _set_slot(tokens, positions, token, pos, b):
             tokens = jax.lax.dynamic_update_slice(
@@ -402,10 +439,13 @@ class PagedBatchEngine:
     # -- the interleaved step ------------------------------------------------
 
     def step(self) -> list[tuple[str, int, bool]]:
-        """One scheduler tick: ONE prefill chunk for the head-of-line
-        prefilling stream, then one batched decode pass advancing every
-        decoding stream one token. Returns [(request_id, token, done)];
-        a stream's first token appears the tick its final chunk lands."""
+        """One scheduler tick = one WINDOW boundary: ONE prefill chunk
+        for the head-of-line prefilling stream, then ONE fused K-tick
+        decode window advancing every decoding stream up to K tokens
+        (device-side completion freezes finished streams mid-window).
+        Returns [(request_id, token, done)] in stream order; a stream's
+        first token appears the tick its final chunk lands, the rest
+        arrive up to K per tick off a single device round-trip."""
         jnp = self._jnp
         np = self._np
         emitted: list[tuple[str, int, bool]] = []
@@ -422,6 +462,7 @@ class PagedBatchEngine:
             )
             s.chunk_base = base + self.chunk
             self.chunks_run += 1
+            self.dispatches += 1
             if s.chunk_base >= s.true_len:  # final chunk: stream starts
                 self._prefillq.popleft()
                 s.prompt = None
@@ -429,6 +470,7 @@ class PagedBatchEngine:
                 # a python index would compile one slice per distinct
                 # prompt-length remainder.
                 token = int(np.asarray(greedy)[s.true_len - 1 - base])
+                self.fetches += 1
                 s.emitted = 1
                 done = (
                     self.eos is not None and token == self.eos
@@ -449,31 +491,64 @@ class PagedBatchEngine:
 
         if any(self._decode):
             if self._members_dirty:
+                # Membership changed at this boundary: rebuild the
+                # device-carried window state from the host slots. (No
+                # position pin needed — the window pins inactive rows
+                # to 0 itself, every tick, via freeze_inactive.)
                 self._mask = jnp.asarray(self._decode, dtype=bool)
-                self._imask = self._mask.astype(jnp.int32)
-                self.positions = jnp.where(self._mask, self.positions, 0)
+                self._emitted_dev = jnp.asarray(
+                    [
+                        s.emitted if s is not None and self._decode[i] else 0
+                        for i, s in enumerate(self.slots)
+                    ],
+                    jnp.int32,
+                )
+                self._maxnew_dev = jnp.asarray(
+                    [
+                        s.max_new if s is not None and self._decode[i] else 0
+                        for i, s in enumerate(self.slots)
+                    ],
+                    jnp.int32,
+                )
                 self._members_dirty = False
             if self._bt_dirty:
                 self._bt_dec = jnp.asarray(
                     self._bt * np.asarray(self._decode, np.int32)[:, None]
                 )
                 self._bt_dirty = False
-            nxt, self.pools = self.batch_step(
-                self.tokens, self.pools, self.positions, self._bt_dec
+            (
+                mat,
+                self.tokens,
+                self.positions,
+                self._mask,
+                self._emitted_dev,
+                self.pools,
+            ) = self.window_step(
+                self.tokens, self.pools, self.positions, self._bt_dec,
+                self._mask, self._emitted_dev, self._maxnew_dev,
             )
-            self.tokens = nxt
-            self.positions = self.positions + self._imask
-            host = np.asarray(nxt)  # ONE device->host transfer
+            self.dispatches += 1
+            host = np.asarray(mat)  # ONE [B, K+1] device->host transfer
+            self.fetches += 1
             for b, slot in enumerate(self.slots):
                 if slot is None or not self._decode[b]:
                     continue
-                token = int(host[b])
-                slot.emitted += 1
-                done = (
-                    slot.emitted >= slot.max_new
-                    or (self.eos is not None and token == self.eos)
-                )
-                emitted.append((slot.request_id, token, done))
-                if done:
-                    self._free_slot(b)
+                # Unpack this row up to its done offset: the host
+                # completion test mirrors the device's exactly (same
+                # emitted counter, same cap, same eos), so the first
+                # host-done token is precisely where the device froze
+                # the row; later columns hold the -1 sentinel.
+                for j in range(self.window):
+                    token = int(host[b, j])
+                    if token < 0:
+                        break
+                    slot.emitted += 1
+                    done = (
+                        slot.emitted >= slot.max_new
+                        or (self.eos is not None and token == self.eos)
+                    )
+                    emitted.append((slot.request_id, token, done))
+                    if done:
+                        self._free_slot(b)
+                        break
         return emitted

@@ -316,13 +316,24 @@ def init_page_pool(cfg: Qwen2Config, num_pages: int, page_size: int,
 def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
                       eos: int | None = None, page_size: int = 16,
                       chunk: int | None = None,
-                      num_pages: int | None = None):
+                      num_pages: int | None = None,
+                      window: int | None = None):
     """Paged-KV continuous-batching engine (requires the quantized fused
     layout, like :func:`make_batch_engine`). Defaults size the pool to
     EXACTLY the dense engine's 4-slot HBM footprint (4 * max_seq KV
     rows per layer, null page included) — the paged engine runs
     ``max_slots`` streams inside it because pages are granted for
-    actual context, not worst-case."""
+    actual context, not worst-case.
+
+    ``window`` is the multi-step decode window K (default: env
+    ``DORA_MULTISTEP_K``, else 8): each engine step runs K fused decode
+    ticks in ONE jitted device program (models/vlm.make_paged_window)
+    and fetches one [B, K+1] token matrix, amortizing host dispatch and
+    device->host fetch cost across K tokens. ``window=1`` is the
+    per-token dispatch behavior of the pre-window engine, same greedy
+    tokens either way (asserted in tests/test_paged_engine.py)."""
+    import os
+
     from dora_tpu.models import vlm as _vlm
     from dora_tpu.models.batch_engine import PagedBatchEngine
 
@@ -333,9 +344,15 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
     chunk = chunk or min(256, cfg.max_seq)
     if num_pages is None:
         num_pages = 4 * cfg.max_seq // page_size
-    step = jax.jit(
-        lambda tokens, pools, positions, bts: fused_paged_batch_step(
-            params, cfg, tokens, pools, positions, bts
+    if window is None:
+        window = int(os.environ.get("DORA_MULTISTEP_K", "8"))
+    window_fn = jax.jit(
+        _vlm.make_paged_window(
+            lambda tokens, pools, positions, bts: fused_paged_batch_step(
+                params, cfg, tokens, pools, positions, bts
+            ),
+            k=window,
+            eos=eos,
         ),
         donate_argnums=(1,),
     )
@@ -348,7 +365,8 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
     return PagedBatchEngine(
         init_pool=lambda n: init_page_pool(cfg, n, page_size),
         chunk_prefill=chunk_fn,
-        batch_step=step,
+        window_step=window_fn,
+        window=window,
         max_slots=max_slots,
         max_seq=cfg.max_seq,
         page_size=page_size,

@@ -544,6 +544,67 @@ def fused_paged_pass_chunk(params, x, pools, position, block_table,
     )
 
 
+def make_paged_window(step_fn, *, k: int, eos: int | None = None):
+    """Fused K-step decode window over a paged batch step.
+
+    ONE jitted program runs ``k`` batched decode ticks on device,
+    carrying ``(tokens, positions, active, emitted)`` plus the shared
+    KV pools through a ``lax.scan``. Per-row completion — EOS hit or
+    ``emitted >= max_new`` (``max_new`` ships as a per-slot device
+    vector) — is detected ON DEVICE, and a finished row freezes
+    mid-window: :func:`ops.decode_block.freeze_inactive` pins its
+    position to 0 and zeroes its block-table row, routing the frozen
+    row's KV writes to the reserved null page exactly like the
+    engine's between-step masked-decode view. The host gets one
+    ``[B, k+1]`` int32 matrix back — k emitted-token columns (``-1``
+    where a row was already frozen) plus the final active mask as the
+    last column — ONE device->host fetch per window instead of one per
+    token.
+
+    ``step_fn(tokens, pools, positions, bts) -> (greedy [B], pools)``
+    is the family's batched paged decode closure (e.g.
+    ``qwen2.fused_paged_batch_step`` partially applied). ``k`` and
+    ``eos`` are closed over; every traced operand keeps a fixed [B] /
+    [B, P] shape, so the window compiles exactly one XLA program ever
+    (the PR-4 chunk-prefill discipline).
+
+    Returns ``window(tokens, pools, positions, bts, active, emitted,
+    max_new) -> (mat [B, k+1], tokens, positions, active, emitted,
+    pools)`` — the carried state comes back so the host replaces its
+    device refs and only rebuilds them when slot membership changes.
+    """
+    from dora_tpu.ops import decode_block as DB
+
+    def window(tokens, pools, positions, bts, active, emitted, max_new):
+        def tick(carry, _):
+            tokens, pools, positions, active, emitted = carry
+            alive = active.astype(jnp.int32)
+            pos_in, bts_in = DB.freeze_inactive(positions, bts, active)
+            nxt, pools = step_fn(tokens, pools, pos_in, bts_in)
+            out = jnp.where(active, nxt, -1)  # -1 = row was frozen
+            emitted = emitted + alive
+            done = emitted >= max_new
+            if eos is not None:
+                done = done | (nxt == eos)
+            # A frozen row keeps its last real token/position so the
+            # host never has to rewrite them before the next window.
+            tokens = jnp.where(active, nxt, tokens)
+            positions = pos_in + alive
+            active = active & ~done
+            return (tokens, pools, positions, active, emitted), out
+
+        (tokens, pools, positions, active, emitted), toks = jax.lax.scan(
+            tick, (tokens, pools, positions, active, emitted), None,
+            length=k,
+        )
+        mat = jnp.concatenate(
+            [toks.T, active.astype(jnp.int32)[:, None]], axis=1
+        )
+        return mat, tokens, positions, active, emitted, pools
+
+    return window
+
+
 def generate_tp(params, tp_params, cfg: VLMConfig, images, prompt_ids,
                 max_new_tokens: int, mesh):
     """Greedy generation with the decode scan on the FUSED kernel tier

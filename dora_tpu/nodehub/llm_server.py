@@ -26,11 +26,20 @@ into the fused decode layout — int8 by default, DORA_INT4_DECODE=1 for
 int4); without a checkpoint the node refuses loudly (a chat server with
 random weights helps nobody).
 
+The event loop runs at WINDOW granularity: each engine step launches
+one fused K-tick decode window (DORA_MULTISTEP_K, default 8) and gets
+up to K tokens per stream back off a single device round-trip, so host
+dispatch/fetch cost amortizes across K tokens. Admissions, prefill
+chunks and backlog draining happen at window boundaries — TTFT and
+backlog latency quantize to one window.
+
 Env: DORA_BATCH_SLOTS (default 16 paged / 4 dense) concurrent streams;
 DORA_MAX_NEW_TOKENS (default 32) per-request cap (a request's
 ``max_tokens`` lowers it); DORA_MAX_SEQ cache length; DORA_PAGE_SIZE
 (default 16) KV rows per page; DORA_PREFILL_CHUNK prefill chunk rows
-(default min(256, max_seq)); DORA_PAGED_KV=0 for the dense engine.
+(default min(256, max_seq)); DORA_MULTISTEP_K (default 8) fused decode
+ticks per dispatch (1 = per-token dispatch); DORA_PAGED_KV=0 for the
+dense engine (always per-token).
 
 Serving metrics (slots, free pages, backlog, decode tokens/s, TTFT
 histogram) ship to the daemon every second and surface in
@@ -69,10 +78,88 @@ def make_engine(params, cfg, eos=None):
     page_size = int(os.environ.get("DORA_PAGE_SIZE", "16"))
     chunk_env = os.environ.get("DORA_PREFILL_CHUNK")
     chunk = int(chunk_env) if chunk_env else None
+    window = int(os.environ.get("DORA_MULTISTEP_K", "8"))
     return qwen2.make_paged_engine(
         params, cfg, max_slots=slots, eos=eos, page_size=page_size,
-        chunk=chunk,
+        chunk=chunk, window=window,
     )
+
+
+class AdmissionQueue:
+    """FIFO backlog in front of a serving engine.
+
+    Only ``fits()``-admissible requests ever enter (the caller rejects
+    never-admissible ones up front), so the head can always eventually
+    start once capacity frees. :meth:`drain` must run at EVERY point
+    capacity may have appeared — after a push, after an engine step
+    freed slots/pages, and on the idle path — a parked request must
+    never wait for unrelated traffic to trigger its admission
+    (regression: tests/test_llm_backlog.py)."""
+
+    def __init__(self, engine, start):
+        self._engine = engine
+        self._start = start
+        self._q: list[tuple[str, list[int], int]] = []
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, key: str, ids: list[int], max_new: int) -> None:
+        self._q.append((key, ids, max_new))
+        self.drain()
+
+    def drain(self) -> None:
+        while self._q and self._engine.can_admit(
+            len(self._q[0][1]), self._q[0][2]
+        ):
+            self._start(*self._q.pop(0))
+
+
+def _run_loop(node, engine, backlog, metrics, handle_input, emit,
+              report, clock=time.monotonic) -> None:
+    """Window-granular serving loop, factored out of :func:`main` so
+    tests can drive it with fake nodes/engines. Each iteration: drain
+    one event, run one engine step (one prefill chunk + one K-tick
+    decode window), then ALWAYS drain the backlog — capacity appears
+    when a step frees slots/pages, but also the idle path must admit
+    (a parked request with zero active streams used to sit until
+    unrelated traffic arrived)."""
+    last_step_end: float | None = None
+    report_last = clock()
+    while True:
+        # Active decode: poll only (the engine must keep stepping);
+        # idle: park in recv (bounded — recv returns None on timeout,
+        # so the idle path below still runs a few times a second).
+        event = node.recv(timeout=0.0 if engine.active else 0.25)
+        if (
+            event is None
+            and node.stream_ended
+            and engine.active == 0
+            and len(backlog) == 0
+        ):
+            break
+        if event is not None:
+            if event["type"] == "STOP":
+                break
+            if event["type"] == "INPUT":
+                handle_input(event)
+        if engine.active:
+            now = clock()
+            if last_step_end is not None:
+                # Host time between the end of the previous dispatch
+                # and the start of this one: the gap the K-window
+                # exists to amortize (p50/p99 in the SERVING table).
+                metrics.dispatch_gap.observe((now - last_step_end) * 1e6)
+            for key, token, done in engine.step():
+                emit(key, token, done)
+            last_step_end = clock()
+        else:
+            last_step_end = None  # a gap across idle is queue wait
+        backlog.drain()
+        now = clock()
+        if now - report_last >= 1.0:
+            report(now)
+            report_last = now
 
 
 def main() -> None:
@@ -123,10 +210,6 @@ def main() -> None:
     paged = hasattr(engine, "free_pages")
     metrics = ServingMetrics(engine="paged" if paged else "dense")
     node = Node()
-    #: requests that arrived while the engine couldn't admit them
-    #: (FIFO admission; only fits()-admissible requests ever enter, so
-    #: freed slots/pages can always eventually take the head)
-    backlog: list[tuple[str, list[int], int]] = []
     #: engine key -> wire request_id. The ENGINE key is always unique
     #: (req-N): two in-flight requests carrying the same wire
     #: ``request_id`` must not share a slot key, or their token streams
@@ -169,17 +252,49 @@ def main() -> None:
         # paged engine: submit queues the prefill; the first token is
         # emitted by a later step() when the final chunk lands.
 
-    def admit_backlog() -> None:
-        while backlog and engine.can_admit(
-            len(backlog[0][1]), backlog[0][2]
-        ):
-            start(*backlog.pop(0))
+    #: requests that arrived while the engine couldn't admit them
+    backlog = AdmissionQueue(engine, start)
+
+    def handle_input(event) -> None:
+        meta = event.get("metadata") or {}
+        rid = meta.get("request_id")
+        value = event["value"]
+        text = (
+            value.to_pylist()[0]
+            if isinstance(value, pa.Array)
+            else bytes(value or b"").decode(errors="replace")
+        )
+        req_counter[0] += 1
+        key = f"req-{req_counter[0]}"
+        wire_ids[key] = rid
+        metrics.requests += 1
+        ids = encode(text) or [0]
+        max_new = min(
+            int(meta.get("max_new_tokens", max_new_cap)),
+            max_new_cap,
+        )
+        if max_new <= 0:
+            # max_tokens <= 0 asks for nothing: close the stream
+            # empty instead of fabricating a token.
+            metrics.rejected += 1
+            emit_text(key, "", True, finish="length")
+        elif not engine.fits(len(ids), max_new):
+            # Oversized: close the stream empty — never fabricate a
+            # token as a "successful" answer.
+            metrics.rejected += 1
+            emit_text(key, "", True, finish="length")
+        else:
+            t_admitted[key] = time.monotonic()
+            backlog.push(key, ids, max_new)  # push drains: admits now
+            # when the engine can, else parks until capacity frees
 
     def report(now: float) -> None:
         metrics.slots_active = engine.active
         metrics.slots_total = engine.max_slots
         metrics.backlog_depth = len(backlog)
         metrics.prefill_chunks = getattr(engine, "chunks_run", 0)
+        metrics.host_dispatches = getattr(engine, "dispatches", 0)
+        metrics.host_fetches = getattr(engine, "fetches", 0)
         if paged:
             metrics.free_pages = engine.free_pages
             metrics.total_pages = engine.allocator.num_pages - 1
@@ -187,60 +302,9 @@ def main() -> None:
             node.report_serving(metrics.snapshot())
         except Exception:
             pass  # metrics are best-effort; serving never blocks on them
-        report.last = now
-
-    report.last = time.monotonic()
 
     try:
-        while True:
-            # Active decode: poll only (the engine must keep stepping);
-            # idle: park in recv until a request arrives.
-            event = node.recv(timeout=0.0 if engine.active else 0.25)
-            if event is None and node.stream_ended and engine.active == 0:
-                break
-            if event is not None:
-                if event["type"] == "STOP":
-                    break
-                if event["type"] == "INPUT":
-                    meta = event.get("metadata") or {}
-                    rid = meta.get("request_id")
-                    value = event["value"]
-                    text = (
-                        value.to_pylist()[0]
-                        if isinstance(value, pa.Array)
-                        else bytes(value or b"").decode(errors="replace")
-                    )
-                    req_counter[0] += 1
-                    key = f"req-{req_counter[0]}"
-                    wire_ids[key] = rid
-                    metrics.requests += 1
-                    ids = encode(text) or [0]
-                    max_new = min(
-                        int(meta.get("max_new_tokens", max_new_cap)),
-                        max_new_cap,
-                    )
-                    if max_new <= 0:
-                        # max_tokens <= 0 asks for nothing: close the
-                        # stream empty instead of fabricating a token.
-                        metrics.rejected += 1
-                        emit_text(key, "", True, finish="length")
-                    elif not engine.fits(len(ids), max_new):
-                        # Oversized: close the stream empty — never
-                        # fabricate a token as a "successful" answer.
-                        metrics.rejected += 1
-                        emit_text(key, "", True, finish="length")
-                    elif not engine.can_admit(len(ids), max_new):
-                        t_admitted[key] = time.monotonic()
-                        backlog.append((key, ids, max_new))
-                    else:
-                        t_admitted[key] = time.monotonic()
-                        start(key, ids, max_new)
-            for key, token, done in engine.step():
-                emit(key, token, done)
-            admit_backlog()
-            now = time.monotonic()
-            if now - report.last >= 1.0:
-                report(now)
+        _run_loop(node, engine, backlog, metrics, handle_input, emit, report)
     finally:
         report(time.monotonic())
         node.close()

@@ -1,0 +1,16 @@
+"""Version shims for the Pallas TPU namespace, applied once at import.
+
+jax renamed ``pltpu.TPUCompilerParams`` -> ``pltpu.CompilerParams``
+around 0.5. Every kernel module used to carry its own copy of the
+patch; importing this module instead keeps the kernel tier running on
+whichever toolchain the container carries, from one place::
+
+    from dora_tpu.ops import _compat  # noqa: F401
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
+    pltpu.CompilerParams = pltpu.TPUCompilerParams

@@ -42,12 +42,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_LANE = 128
+from dora_tpu.ops import _compat  # noqa: F401  (pltpu.CompilerParams shim)
 
-# jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept both
-# so the kernel tier runs on every toolchain the container may carry.
-if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
-    pltpu.CompilerParams = pltpu.TPUCompilerParams
+_LANE = 128
 
 
 def _interpret() -> bool:
@@ -1606,6 +1603,18 @@ def lm_head_argmax(x, norm_w, w, s, *, eps: float = 1e-6,
 # ---------------------------------------------------------------------------
 # rope row prep (shared by the fused step)
 # ---------------------------------------------------------------------------
+
+
+def freeze_inactive(positions, block_tables, active):
+    """Mask-adjusted operands for one paged decode tick: inactive rows
+    pin to position 0 and get an all-zero block-table row, so their KV
+    writes land in the reserved null page and their attention sweep
+    degenerates to one harmless row — the same discipline the paged
+    engine applies between steps, made reusable INSIDE a scan body so a
+    multi-step window can freeze a stream the very tick it finishes.
+    positions [B] i32, block_tables [B, P] i32, active [B] bool."""
+    a = active.astype(jnp.int32)
+    return jnp.where(active, positions, 0), block_tables * a[:, None]
 
 
 def rope_rows_at(cos_table, sin_table, positions):

@@ -38,8 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover - version shim
-    pltpu.CompilerParams = pltpu.TPUCompilerParams
+from dora_tpu.ops import _compat  # noqa: F401  (pltpu.CompilerParams shim)
 
 BLOCK_Q = 128
 BLOCK_K = 256

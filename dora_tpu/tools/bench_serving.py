@@ -11,13 +11,22 @@ Two axes, one JSON line on stdout:
   them concurrently; the dense engine (4 slots, SAME HBM) must queue
   12 of them — wall clock and TTFT p99 show what paging buys.
 
+A third axis behind ``--multistep``: the K-sweep of the fused
+multi-step decode window (K in {1, 4, 8, 16}) at 4 and 16 streams,
+reporting HOST ROUND-TRIPS (engine dispatches + device->host fetches)
+per emitted token next to tok/s. Round-trips are host-side counts —
+immune to the tunnel-drift caveat that clouds wall-clock numbers
+(KNOWN_ISSUES round 4: ``block_until_ready`` does not synchronize the
+axon-tunneled chip, so e2e timings drift; the dispatch-amortization
+claim rides the counters, not the clock).
+
 Model: ``DORA_HF_CHECKPOINT`` when set (real numbers on the TPU box);
 otherwise a tiny random Qwen2 is built in-process and the numbers are
 relative-only (CPU smoke A/B, same code path).
 
 Usage::
 
-    python -m dora_tpu.tools.bench_serving
+    python -m dora_tpu.tools.bench_serving [--multistep]
 """
 
 from __future__ import annotations
@@ -90,6 +99,79 @@ def _stats(tokens: int, wall: float, ttfts: list[float]) -> dict:
     }
 
 
+def _multistep_sweep(qwen2, path: str, real: bool) -> dict:
+    """K-sweep of the multi-step decode window: host round-trips per
+    emitted token + tok/s at K in {1, 4, 8, 16}, 4 and 16 streams.
+
+    The workload is decode-heavy on purpose (short prompts, long
+    generations): the window amortizes per-TOKEN dispatch/fetch cost,
+    so the regime where decode dominates prefill is the one the ≥4x
+    K=8-vs-K=1 round-trip gate is stated for. Warmup legs run short
+    (shapes are identical regardless of max_new, so compiles are the
+    same); measured legs read counter DELTAS around the run."""
+    import jax
+    import numpy as np
+
+    # A longer cache than the engine-A/B smoke so generations are long
+    # enough for decode to dominate (tiny CPU: 4-token prompts, 120 new
+    # tokens inside max_seq 128).
+    if real:
+        max_seq = int(os.environ.get("DORA_MAX_SEQ", "512"))
+        page_size, chunk, plen = 16, 64, 64
+        max_new = {4: min(256, max_seq - plen), 16: 32}
+    else:
+        max_seq, page_size, chunk, plen = 128, 8, 8, 4
+        max_new = {4: 120, 16: 24}
+
+    cfg, params = qwen2.load(path, max_seq=max_seq)
+    os.environ.setdefault("DORA_INT8_DECODE", "1")
+    params = qwen2.quantize_decode(params, cfg)
+    rng = np.random.default_rng(3)
+
+    def prompts(n: int) -> list[list[int]]:
+        return [
+            rng.integers(0, cfg.vocab, size=plen).tolist() for _ in range(n)
+        ]
+
+    out: dict = {
+        "backend": jax.default_backend(),
+        "model": "checkpoint" if real else "tiny-random",
+        "plen": plen,
+        "max_new": {str(s): m for s, m in max_new.items()},
+        "k_sweep": {},
+    }
+    per_k: dict[int, dict] = {}
+    for streams in (4, 16):
+        leg: dict = {}
+        for k in (1, 4, 8, 16):
+            engine = qwen2.make_paged_engine(
+                params, cfg, max_slots=streams, page_size=page_size,
+                chunk=chunk, window=k,
+            )
+            _serve(engine, prompts(streams), 4)  # warmup: compile only
+            d0, f0 = engine.dispatches, engine.fetches
+            tokens, wall, ttfts = _serve(
+                engine, prompts(streams), max_new[streams]
+            )
+            trips = (engine.dispatches - d0) + (engine.fetches - f0)
+            stats = _stats(tokens, wall, ttfts)
+            stats["round_trips"] = trips
+            stats["rt_per_token"] = round(trips / tokens, 4)
+            stats["tokens_per_dispatch"] = round(
+                tokens / (engine.dispatches - d0), 2
+            )
+            leg[f"k{k}"] = stats
+        out["k_sweep"][f"streams{streams}"] = leg
+        per_k[streams] = leg
+    # The acceptance headline: K=8 vs K=1 round-trips per token at 4
+    # streams (the decode-dominated leg).
+    s4 = per_k[4]
+    out["k8_vs_k1_rt_reduction"] = round(
+        s4["k1"]["rt_per_token"] / s4["k8"]["rt_per_token"], 2
+    )
+    return out
+
+
 def main() -> int:
     import numpy as np
 
@@ -101,6 +183,9 @@ def main() -> int:
     if not real:
         tmp = tempfile.mkdtemp(prefix="bench-serving-")
         path = _tiny_checkpoint(tmp)
+    if "--multistep" in sys.argv[1:]:
+        print(json.dumps({"multistep": _multistep_sweep(qwen2, path, real)}))
+        return 0
     # Workload scales with the model: the real box gets 64-token prompts
     # and 32 new tokens inside the default (dense-4-footprint) pool; the
     # tiny CPU smoke shrinks everything to stay admissible at 16 streams

@@ -127,7 +127,7 @@ def test_pages_needed_covers_chunk_padding():
     from dora_tpu.models.batch_engine import PagedBatchEngine
 
     e = PagedBatchEngine(
-        init_pool=lambda n: {}, chunk_prefill=None, batch_step=None,
+        init_pool=lambda n: {}, chunk_prefill=None, window_step=None,
         max_slots=2, max_seq=64, page_size=8, chunk=16, num_pages=9,
     )
     # chunked prefill writes WHOLE pages: a 3-token prompt still burns a
@@ -142,7 +142,7 @@ def test_pages_needed_covers_chunk_padding():
     # a second stream can't co-reside with a pool-filling one: admission
     # is page-aware, not just slot-aware
     e2 = PagedBatchEngine(
-        init_pool=lambda n: {}, chunk_prefill=None, batch_step=None,
+        init_pool=lambda n: {}, chunk_prefill=None, window_step=None,
         max_slots=2, max_seq=64, page_size=8, chunk=16, num_pages=9,
     )
     e2.allocator.alloc(8)
@@ -184,37 +184,114 @@ def test_paged_matches_dense_across_staggered_admissions(
     while dense.active:
         _drain(dstreams, dense.step())
 
-    # Paged engine, same prompts, admissions staggered mid-decode.
-    paged = qwen2.make_paged_engine(
-        qparams, cfg, max_slots=5, page_size=8, chunk=8
+    # Paged engine, same prompts, admissions staggered mid-decode —
+    # once at per-token dispatch (K=1) and once with the fused 8-tick
+    # decode window: identical streams either way.
+    rt: dict[int, int] = {}
+    for window in (1, 8):
+        paged = qwen2.make_paged_engine(
+            qparams, cfg, max_slots=5, page_size=8, chunk=8, window=window
+        )
+        pstreams: dict[str, list[int]] = {
+            f"r{i}": [] for i in range(len(plens))
+        }
+        paged.submit("r0", prompts[0], max_new)
+        for _ in range(3):
+            _drain(pstreams, paged.step())
+        paged.submit("r1", prompts[1], max_new)
+        paged.submit("r2", prompts[2], max_new)
+        _drain(pstreams, paged.step())
+        paged.submit("r3", prompts[3], max_new)  # 5-chunk prompt mid-run
+        _drain(pstreams, paged.step())
+        paged.submit("r4", prompts[4], max_new)
+        for _ in range(300):
+            if not paged.active:
+                break
+            _drain(pstreams, paged.step())
+        assert paged.active == 0
+        rt[window] = paged.dispatches + paged.fetches
+
+        for i in range(len(plens)):
+            rid = f"r{i}"
+            assert pstreams[rid] == dstreams[rid], (
+                f"paged K={window} stream {rid} diverged from dense"
+            )
+            assert pstreams[rid] == serial_ref(prompts[i], max_new), (
+                f"K={window} stream {rid} diverged from the serial ref"
+            )
+
+        # Every page returned to the allocator (no leaks across the run).
+        assert paged.free_pages == paged.allocator.num_pages - 1
+
+    # The window amortizes host round-trips even on this short workload.
+    assert rt[8] < rt[1], rt
+
+
+def test_window_freezes_streams_mid_window(quantized, serial_ref):
+    """Device-side completion INSIDE a K=8 window: one stream hits EOS
+    mid-window, another's max_new expires mid-window. The window must
+    freeze each the very tick it finishes (KV writes rerouted to the
+    null page), the host unpack must truncate at the done offset, and
+    the emitted streams must be identical to K=1 and the dense engine
+    with the same eos."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, cfg.vocab, size=n).tolist() for n in (4, 6)]
+    max_new = (12, 5)  # r1's cap expires at tick 4 of its first window
+
+    # Pick eos = r0's 6th greedy token: with K=8 the EOS lands at tick 5
+    # of r0's first full window — strictly inside it.
+    ref0 = serial_ref(prompts[0], max_new[0])
+    eos = ref0[5]
+
+    def expect(i: int) -> list[int]:
+        out = []
+        for t in serial_ref(prompts[i], max_new[i])[: max_new[i]]:
+            out.append(t)
+            if t == eos:
+                break
+        return out
+
+    def run(make):
+        engine = make()
+        streams: dict[str, list[int]] = {"r0": [], "r1": []}
+        first = engine.submit("r0", prompts[0], max_new[0])
+        if first is not None:  # dense submit is synchronous
+            streams["r0"].append(first[0])
+        first = engine.submit("r1", prompts[1], max_new[1])
+        if first is not None:
+            streams["r1"].append(first[0])
+        for _ in range(100):
+            if not engine.active:
+                break
+            _drain(streams, engine.step())
+        assert engine.active == 0
+        return streams
+
+    dense = run(
+        lambda: qwen2.make_batch_engine(qparams, cfg, max_slots=2, eos=eos)
     )
-    pstreams: dict[str, list[int]] = {f"r{i}": [] for i in range(len(plens))}
-    paged.submit("r0", prompts[0], max_new)
-    for _ in range(3):
-        _drain(pstreams, paged.step())
-    paged.submit("r1", prompts[1], max_new)
-    paged.submit("r2", prompts[2], max_new)
-    _drain(pstreams, paged.step())
-    paged.submit("r3", prompts[3], max_new)  # 5-chunk prompt mid-flight
-    _drain(pstreams, paged.step())
-    paged.submit("r4", prompts[4], max_new)
-    for _ in range(300):
-        if not paged.active:
-            break
-        _drain(pstreams, paged.step())
-    assert paged.active == 0
-
-    for i in range(len(plens)):
-        rid = f"r{i}"
-        assert pstreams[rid] == dstreams[rid], (
-            f"paged stream {rid} diverged from dense"
+    k1 = run(
+        lambda: qwen2.make_paged_engine(
+            qparams, cfg, max_slots=2, page_size=8, chunk=8, eos=eos,
+            window=1,
         )
-        assert pstreams[rid] == serial_ref(prompts[i], max_new), (
-            f"stream {rid} diverged from the serial reference"
+    )
+    k8 = run(
+        lambda: qwen2.make_paged_engine(
+            qparams, cfg, max_slots=2, page_size=8, chunk=8, eos=eos,
+            window=8,
         )
-
-    # Every page returned to the allocator (no leaks across the run).
-    assert paged.free_pages == paged.allocator.num_pages - 1
+    )
+    for rid, i in (("r0", 0), ("r1", 1)):
+        want = expect(i)
+        assert dense[rid] == want, f"dense {rid}"
+        assert k1[rid] == want, f"paged K=1 {rid}"
+        assert k8[rid] == want, f"paged K=8 {rid}"
+    # EOS actually cut r0 short and the cap cut r1 short (mid-window).
+    assert len(k8["r0"]) == 6 and len(k8["r1"]) == 5
 
 
 def test_16_slots_inside_the_dense_4_slot_footprint(quantized, serial_ref):
@@ -227,7 +304,7 @@ def test_16_slots_inside_the_dense_4_slot_footprint(quantized, serial_ref):
 
     cfg, qparams = quantized
     paged = qwen2.make_paged_engine(
-        qparams, cfg, max_slots=16, page_size=8, chunk=8
+        qparams, cfg, max_slots=16, page_size=8, chunk=8, window=8
     )
     dense_caches = qwen2.init_cache(cfg, 4)
     pool_bytes = sum(
@@ -268,20 +345,25 @@ def test_16_slots_inside_the_dense_4_slot_footprint(quantized, serial_ref):
 
 
 def test_steady_state_adds_zero_compiles_and_one_chunk_shape(quantized):
-    """After warmup, admissions at NEW prompt lengths plus decode steps
-    must not trigger a single XLA compile (positions, block tables and
-    chunk offsets are all traced operands), and the chunked-prefill jit
-    holds exactly ONE compiled shape — the dense engine's
-    one-compile-per-bucket zoo is structurally gone."""
+    """After warmup, admissions at NEW prompt lengths plus decode
+    drains must not trigger a single XLA compile — at K=8 AND at K=1
+    (positions, block tables, chunk offsets, the active mask and the
+    emitted/max_new vectors are all traced operands of fixed shape).
+    The chunked-prefill jit and the K-window jit each hold exactly ONE
+    compiled shape — the dense engine's one-compile-per-bucket zoo is
+    structurally gone."""
     from dora_tpu.models.hf import qwen2
 
     cfg, qparams = quantized
-    engine = qwen2.make_paged_engine(
-        qparams, cfg, max_slots=4, page_size=8, chunk=16
-    )
+    engines = {
+        k: qwen2.make_paged_engine(
+            qparams, cfg, max_slots=4, page_size=8, chunk=16, window=k
+        )
+        for k in (8, 1)
+    }
     rng = np.random.default_rng(7)
 
-    def run(lengths: tuple[int, ...]) -> None:
+    def run(engine, lengths: tuple[int, ...]) -> None:
         streams: dict[str, list[int]] = {}
         for i, n in enumerate(lengths):
             rid = f"w{n}-{i}"
@@ -295,18 +377,22 @@ def test_steady_state_adds_zero_compiles_and_one_chunk_shape(quantized):
                 return
             _drain(streams, engine.step())
 
-    run((3, 12, 20))  # warmup: single- and multi-chunk prompts
+    for engine in engines.values():
+        run(engine, (3, 12, 20))  # warmup: single- and multi-chunk
     warm = len(_COMPILE_EVENTS)
 
-    run((5, 9, 17, 33, 2))  # five NEW lengths, staggered with decode
+    for engine in engines.values():
+        run(engine, (5, 9, 17, 33, 2))  # five NEW lengths, both K
     assert len(_COMPILE_EVENTS) == warm, (
         f"steady-state serving compiled "
         f"{len(_COMPILE_EVENTS) - warm} new XLA program(s)"
     )
-    # Exactly one chunk shape ever: the prefill jit's cache holds one
-    # entry after serving prompt lengths from 2 to 33.
-    assert engine.chunk_prefill._cache_size() == 1
-    assert engine.batch_step._cache_size() == 1
+    for k, engine in engines.items():
+        # Exactly one chunk shape and one window shape ever: each jit's
+        # cache holds one entry after prompt lengths from 2 to 33 and
+        # every slot-membership pattern the drains walked through.
+        assert engine.chunk_prefill._cache_size() == 1, f"K={k}"
+        assert engine.window_step._cache_size() == 1, f"K={k}"
 
 
 def test_dense_engine_mask_cached_across_unchanged_passes(quantized):

@@ -26,11 +26,21 @@ def test_serving_snapshot_shape():
     m.backlog_depth = 1
     m.ttft.observe(2_500.0)
     m.ttft.observe(9_000.0)
+    m.host_dispatches = 16
+    m.host_fetches = 12
+    m.dispatch_gap.observe(700.0)
     snap = m.snapshot()
     assert snap["engine"] == "paged"
     assert snap["decode_tokens"] == 40
     assert snap["ttft_us"]["count"] == 2
     assert snap["ttft_us"]["p50_us"] is not None
+    # Round-trip amortization keys (multi-step window observability).
+    assert snap["host_dispatches"] == 16
+    assert snap["host_fetches"] == 12
+    assert snap["tokens_per_dispatch"] == 2.5  # 40 / 16
+    assert snap["dispatch_gap_us"]["count"] == 1
+    # No dispatches yet -> no rate, not a div-by-zero.
+    assert ServingMetrics().snapshot()["tokens_per_dispatch"] is None
 
 
 def test_merge_unions_serving_across_daemons():
@@ -58,9 +68,15 @@ def test_render_serving_table_with_rates():
                     "free_pages": 120,
                     "total_pages": 128,
                     "backlog_depth": 2,
+                    "host_dispatches": 30,
+                    "host_fetches": 28,
+                    "tokens_per_dispatch": 5.0,
                     "ttft_us": {
                         "count": 4, "p50_us": 2500.0, "p90_us": 8000.0,
                         "p99_us": 9000.0,
+                    },
+                    "dispatch_gap_us": {
+                        "count": 30, "p50_us": 512.0, "p99_us": 4096.0,
                     },
                 }
             }
@@ -72,8 +88,15 @@ def test_render_serving_table_with_rates():
     assert "120/128" in out  # pages
     assert "50.0" in out  # (150 - 50) / 2.0 tok/s
     assert "2.5ms" in out  # ttft p50
+    assert "TOK/DISP" in out and "5.0" in out  # tokens per dispatch
+    assert "GAP P50" in out and "512µs" in out  # dispatch-gap histogram
     one_shot = render_metrics("u", snap(150))
     assert "llm (paged)" in one_shot  # renders without watch deltas too
+    # Snapshots predating the window metrics render with dashes.
+    bare = snap(10)
+    for key in ("tokens_per_dispatch", "dispatch_gap_us"):
+        del bare["serving"]["llm"][key]
+    assert "llm (paged)" in render_metrics("u", bare)
 
 
 REPORTER = textwrap.dedent(
