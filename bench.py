@@ -496,6 +496,43 @@ def serving_multistep_ab() -> dict:
     return data
 
 
+def serving_trace_ab() -> dict:
+    """Serving-span recorder A/B (tools/bench_serving --trace-ab): a
+    16-stream paged decode run with lifecycle tracing off vs on, trials
+    interleaved. Tracing-on pays per-window s_decode_window spans,
+    per-chunk s_prefill_chunk spans, and admission spans into the flight
+    ring; the gate is ≤3% wall-clock overhead so the serving timeline
+    can stay on in production. Fresh subprocess for the same
+    accelerator-claim reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--trace-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "trace_ab" in row:
+            data = row["trace_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "off_wall_s": None,
+            "on_wall_s": None,
+            "overhead_pct": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -658,6 +695,16 @@ def main() -> int:
         }
 
     try:
+        trace_ab = serving_trace_ab()
+    except Exception as exc:
+        trace_ab = {
+            "off_wall_s": None,
+            "on_wall_s": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -691,6 +738,7 @@ def main() -> int:
         "tracing_ab": tracing_ab,
         "serving_engine_ab": engine_ab,
         "serving_multistep_ab": multistep_ab,
+        "serving_trace_ab": trace_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

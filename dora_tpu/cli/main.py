@@ -367,27 +367,41 @@ def cmd_metrics(args) -> int:
 
     with _control(args) as c:
         prev = None
+        history: list[dict] = []
+        last_at: float | None = None
         while True:
             reply = c.request(
                 cm.QueryMetrics(dataflow_uuid=args.uuid, name=args.name)
             )
+            now = time.monotonic()
             if isinstance(reply, cm.Error):
                 print(reply.message, file=sys.stderr)
                 return 1
             if args.json:
                 print(json.dumps(reply.metrics, indent=2, sort_keys=True))
                 return 0
+            # Rates divide by the MEASURED time since the previous
+            # snapshot, not the nominal --interval (a slow control-plane
+            # round trip would otherwise inflate every rate).
+            elapsed = now - last_at if last_at is not None else None
             text = render_metrics(
                 reply.dataflow_uuid,
                 reply.metrics,
                 prev=prev,
-                interval=args.interval if args.watch else None,
+                interval=(
+                    (elapsed if elapsed is not None else args.interval)
+                    if args.watch else None
+                ),
+                history=history if args.watch else None,
             )
             if not args.watch:
                 print(text, end="")
                 return 0
             print("\x1b[2J\x1b[H" + text, end="", flush=True)
             prev = reply.metrics
+            history.append(reply.metrics)
+            del history[:-48]  # sparkline window
+            last_at = now
             time.sleep(args.interval)
 
 

@@ -26,6 +26,27 @@ def _fmt_us(us: float | None) -> str:
     return f"{us / 1_000_000:.2f}s"
 
 
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(fracs: list[float]) -> str:
+    """0..1 fractions as block characters (page-occupancy history)."""
+    top = len(_SPARK) - 1
+    return "".join(
+        _SPARK[round(min(max(f, 0.0), 1.0) * top)] for f in fracs
+    )
+
+
+def _rate(cur: int, before: int, dt: float) -> str:
+    """Counter delta over ``dt`` seconds. A negative delta means the
+    counter reset (node restart) — render ``-`` instead of a fabricated
+    negative rate."""
+    delta = cur - before
+    if delta < 0:
+        return "-"
+    return f"{delta / dt:.1f}"
+
+
 def _table(headers: list[str], rows: list[list[str]]) -> list[str]:
     widths = [len(h) for h in headers]
     for row in rows:
@@ -44,10 +65,16 @@ def render_metrics(
     snap: dict,
     prev: dict | None = None,
     interval: float | None = None,
+    history: list[dict] | None = None,
 ) -> str:
     """One screenful: header (fastroute ratio), per-link throughput table,
     per-input latency/backlog table. ``prev`` + ``interval`` (watch mode)
-    turn counter deltas into msg/s / bytes/s rates."""
+    turn counter deltas into msg/s / bytes/s rates; ``interval`` is the
+    MEASURED wall time between the two snapshots, clamped to >= 1 ms
+    (snapshots come from different daemons — a skewed or back-to-back
+    pair must not explode a rate or divide by ~0). ``history`` (older
+    snapshots, oldest first) draws the page-occupancy sparkline under
+    the SERVING table."""
     fr = snap.get("fastroute", {})
     ratio = fr.get("hit_ratio")
     header = f"dataflow {uuid}"
@@ -62,19 +89,22 @@ def render_metrics(
         header += f"\n  fallback reasons: {listed}"
     lines = [header, ""]
 
+    dt = max(interval, 1e-3) if interval is not None else None
     prev_links = (prev or {}).get("links", {})
     link_rows = []
     for key in sorted(snap.get("links", {})):
         v = snap["links"][key]
         row = [key, str(v.get("msgs", 0)), _fmt_bytes(v.get("bytes", 0))]
-        if interval:
+        if dt:
             before = prev_links.get(key, {})
-            rate = (v.get("msgs", 0) - before.get("msgs", 0)) / interval
-            brate = (v.get("bytes", 0) - before.get("bytes", 0)) / interval
-            row += [f"{rate:.1f}", f"{_fmt_bytes(brate)}/s"]
+            row.append(_rate(v.get("msgs", 0), before.get("msgs", 0), dt))
+            bdelta = v.get("bytes", 0) - before.get("bytes", 0)
+            row.append(
+                "-" if bdelta < 0 else f"{_fmt_bytes(bdelta / dt)}/s"
+            )
         link_rows.append(row)
     headers = ["LINK", "MSGS", "BYTES"]
-    if interval:
+    if dt:
         headers += ["MSG/S", "BYTES/S"]
     if link_rows:
         lines += _table(headers, link_rows) + [""]
@@ -111,14 +141,15 @@ def render_metrics(
             s = serving[nid]
             ttft = s.get("ttft_us", {})
             gap = s.get("dispatch_gap_us", {})
+            fetch = s.get("fetch_us", {})
             toks = s.get("decode_tokens", 0)
-            if interval:
+            if dt:
                 before = prev_serving.get(nid, {})
-                tps = f"{(toks - before.get('decode_tokens', 0)) / interval:.1f}"
+                tps = _rate(toks, before.get("decode_tokens", 0), dt)
             else:
                 tps = "-"
             pages = (
-                f"{s.get('free_pages', 0)}/{s.get('total_pages', 0)}"
+                f"{s.get('used_pages', 0)}/{s.get('total_pages', 0)}"
                 if s.get("total_pages")
                 else "-"
             )
@@ -135,12 +166,36 @@ def render_metrics(
                 _fmt_us(ttft.get("p99_us")),
                 _fmt_us(gap.get("p50_us")),
                 _fmt_us(gap.get("p99_us")),
+                _fmt_us(fetch.get("p50_us")),
+                str(s.get("compiles", 0)),
                 str(s.get("requests", 0)),
             ])
         lines += [""] + _table(
             ["SERVING", "SLOTS", "PAGES", "BACKLOG", "TOKENS", "TOK/S",
              "TOK/DISP", "TTFT P50", "TTFT P99", "GAP P50", "GAP P99",
-             "REQS"],
+             "FETCH P50", "COMPILES", "REQS"],
             serving_rows,
         )
+        # Page-occupancy sparkline: used/total over the watch history
+        # (one cell per refresh, newest right), peak + fragmentation
+        # alongside — the at-a-glance "is the pool the bottleneck".
+        for nid in sorted(serving):
+            s = serving[nid]
+            total = s.get("total_pages") or 0
+            if not total:
+                continue
+            fracs = []
+            for old in (history or []):
+                o = (old.get("serving") or {}).get(nid)
+                if o and o.get("total_pages"):
+                    fracs.append(
+                        o.get("used_pages", 0) / o["total_pages"]
+                    )
+            fracs.append(s.get("used_pages", 0) / total)
+            lines += [
+                f"  pages {nid} [{_sparkline(fracs[-48:])}] "
+                f"{s.get('used_pages', 0)}/{total} "
+                f"peak {s.get('peak_used_pages', 0)} "
+                f"contig {s.get('largest_contig_free', 0)}"
+            ]
     return "\n".join(lines).rstrip() + "\n"

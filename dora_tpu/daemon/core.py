@@ -75,6 +75,23 @@ SHMEM_CHANNEL_CAPACITY = 1 << 20
 MAX_NODE_TRACE_EVENTS = 20_000
 
 
+def _extend_trace_buffer(df, node_id: str, events: list) -> None:
+    """Append a node's ReportTrace chunk to its bounded daemon-side
+    buffer. Trimming is COUNTED (``node_trace_drops``), not silent: the
+    count rides the trace snapshot so QueryTrace replies and the Chrome
+    export can say how many events this second truncation point lost
+    (the ring's own wrap losses are already ``trace_truncated`` events
+    inside the stream)."""
+    buf = df.node_traces.setdefault(node_id, [])
+    buf.extend(events)
+    if len(buf) > MAX_NODE_TRACE_EVENTS:
+        trim = len(buf) - MAX_NODE_TRACE_EVENTS
+        df.node_trace_drops[node_id] = (
+            df.node_trace_drops.get(node_id, 0) + trim
+        )
+        del buf[:trim]
+
+
 @dataclass
 class TokenState:
     """One shared-memory region in flight: who owns it, how many receivers
@@ -152,6 +169,10 @@ class DataflowState:
     #: trace plane: node id -> flight-recorder events the node shipped
     #: via ReportTrace (bounded; see MAX_NODE_TRACE_EVENTS)
     node_traces: dict[str, list] = field(default_factory=dict)
+    #: trace plane: node id -> events the daemon-side cap trimmed away
+    #: (the ring's own wrap losses arrive as trace_truncated events;
+    #: this counts the second truncation point, the buffer here)
+    node_trace_drops: dict[str, int] = field(default_factory=dict)
     #: serving plane: node id -> latest ServingMetrics snapshot the node
     #: shipped via ReportServing (latest-wins; snapshots are cumulative)
     node_serving: dict[str, dict] = field(default_factory=dict)
@@ -705,12 +726,15 @@ class Daemon:
         if daemon_events:
             processes["(daemon)"] = daemon_events
         hlc_ns = self.clock.new_timestamp().physical_ns
-        return {
+        out = {
             "machine": self.machine_id,
             "wall_ns": time.time_ns(),
             "hlc_ns": hlc_ns,
             "processes": processes,
         }
+        if df.node_trace_drops:
+            out["dropped_events"] = dict(df.node_trace_drops)
+        return out
 
     def _payload_bytes(self, df: DataflowState, data: Any) -> bytes | None:
         if data is None:
@@ -1125,10 +1149,7 @@ class Daemon:
             elif isinstance(msg, n2d.ReportDropTokens):
                 self.ack_tokens(df, node_id, msg.drop_tokens)
             elif isinstance(msg, n2d.ReportTrace):
-                buf = df.node_traces.setdefault(node_id, [])
-                buf.extend(msg.events)
-                if len(buf) > MAX_NODE_TRACE_EVENTS:
-                    del buf[: len(buf) - MAX_NODE_TRACE_EVENTS]
+                _extend_trace_buffer(df, node_id, msg.events)
             elif isinstance(msg, n2d.ReportServing):
                 df.node_serving[node_id] = msg.snapshot
             elif isinstance(msg, n2d.P2PAnnounce):

@@ -155,18 +155,34 @@ class ServingMetrics:
     """
 
     __slots__ = (
-        "ttft", "dispatch_gap", "decode_tokens", "prefill_chunks",
+        "ttft", "dispatch_gap", "fetch_latency", "backlog_wait",
+        "grant_pages", "decode_tokens", "prefill_chunks",
         "requests", "rejected", "slots_active", "slots_total",
-        "free_pages", "total_pages", "backlog_depth", "host_dispatches",
-        "host_fetches", "engine",
+        "free_pages", "total_pages", "used_pages", "peak_used_pages",
+        "largest_contig_free", "backlog_depth", "host_dispatches",
+        "host_fetches", "compiles", "engine",
     )
 
     def __init__(self, engine: str = "dense"):
         self.ttft = Histogram()
         #: host time between consecutive engine dispatches while decode
         #: is active — the per-step host overhead the multi-step window
-        #: amortizes (each gap now buys up to K tokens, not 1)
+        #: amortizes (each gap now buys up to K tokens, not 1).
+        #: Split from fetch_latency on purpose: the gap is pure
+        #: host/scheduler time, the fetch is the blocking device->host
+        #: transfer — tunnel drift moves the fetch track, a host-side
+        #: regression moves the gap track (KNOWN_ISSUES round 4).
         self.dispatch_gap = Histogram()
+        #: blocking device->host fetch durations (the sync points:
+        #: chunk greedy reads, the [B, K+1] window matrix), observed by
+        #: the engine via its ``serving_metrics`` hook
+        self.fetch_latency = Histogram()
+        #: time requests spent parked in the admission backlog before
+        #: their slot/page grant (AdmissionQueue on_admit)
+        self.backlog_wait = Histogram()
+        #: per-admission page-grant size -> count (exact — grant sizes
+        #: are small ints; fed by the paged engine at submit)
+        self.grant_pages: dict[int, int] = {}
         self.decode_tokens = 0
         self.prefill_chunks = 0
         self.requests = 0
@@ -175,11 +191,21 @@ class ServingMetrics:
         self.slots_total = 0
         self.free_pages = 0
         self.total_pages = 0
+        self.used_pages = 0
+        #: high-water mark of pages in use (allocator-tracked)
+        self.peak_used_pages = 0
+        #: longest run of physically-adjacent free pages — the
+        #: fragmentation gauge (how large a contiguous grant could be)
+        self.largest_contig_free = 0
         self.backlog_depth = 0
         #: device program launches / device->host fetches (engine
         #: counters, set just before snapshot like the gauges)
         self.host_dispatches = 0
         self.host_fetches = 0
+        #: XLA compiles observed process-wide (telemetry.compile_count,
+        #: runtime listener) — a nonzero delta at steady state is a
+        #: recompile regression, now visible outside pytest
+        self.compiles = 0
         self.engine = engine
 
     def snapshot(self) -> dict:
@@ -193,16 +219,25 @@ class ServingMetrics:
             "slots_total": self.slots_total,
             "free_pages": self.free_pages,
             "total_pages": self.total_pages,
+            "used_pages": self.used_pages,
+            "peak_used_pages": self.peak_used_pages,
+            "largest_contig_free": self.largest_contig_free,
             "backlog_depth": self.backlog_depth,
             "host_dispatches": self.host_dispatches,
             "host_fetches": self.host_fetches,
+            "compiles": self.compiles,
             "tokens_per_dispatch": (
                 round(self.decode_tokens / self.host_dispatches, 2)
                 if self.host_dispatches
                 else None
             ),
+            "grant_pages": {
+                str(k): v for k, v in sorted(self.grant_pages.items())
+            },
             "ttft_us": self.ttft.snapshot(),
             "dispatch_gap_us": self.dispatch_gap.snapshot(),
+            "fetch_us": self.fetch_latency.snapshot(),
+            "backlog_wait_us": self.backlog_wait.snapshot(),
         }
 
 

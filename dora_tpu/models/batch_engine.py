@@ -32,6 +32,7 @@ axis its own design concedes.
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -94,6 +95,20 @@ class BatchEngine:
         #: engine, so the scheduler reads either uniformly)
         self.dispatches = 0
         self.fetches = 0
+        #: observability hooks the serving node attaches after
+        #: construction: ``tracer`` is a telemetry.ServingTracer
+        #: (request-lifecycle spans through the flight recorder),
+        #: ``serving_metrics`` a metrics.ServingMetrics (fetch/grant
+        #: histograms). Both default None — raw-engine tests and benches
+        #: pay one attribute check per hook site, nothing more.
+        self.tracer = None
+        self.serving_metrics = None
+        #: request_id -> seconds its first token sat host-side between
+        #: being fetched and the engine call returning; the server pops
+        #: this and subtracts it from wall-clock TTFT (zero here — the
+        #: dense submit returns the first token synchronously — but the
+        #: field exists so the server reads either engine uniformly).
+        self.emit_lag_s: dict[str, float] = {}
 
     # -- admission -----------------------------------------------------------
 
@@ -129,6 +144,7 @@ class BatchEngine:
                 f"cannot admit: {self.free_slots} slots free, "
                 f"{len(ids)}+{max_new} vs max_seq {self.max_seq}"
             )
+        t_sub = time.perf_counter()
         b = self.slots.index(None)
         tb = _bucket(len(ids), self.max_seq)
         padded = jnp.asarray(
@@ -144,8 +160,20 @@ class BatchEngine:
         self.dispatches += 1
         # Host-read AFTER the insert dispatch: the transfer then overlaps
         # the insert instead of fencing the device before it is queued.
+        t_fetch = time.perf_counter()
         token = int(first[0])
         self.fetches += 1
+        if self.serving_metrics is not None:
+            self.serving_metrics.fetch_latency.observe(
+                (time.perf_counter() - t_fetch) * 1e6
+            )
+        if self.tracer is not None:
+            # One span covers grant + synchronous prefill: the dense
+            # engine has no chunked phase to split out.
+            self.tracer.span(
+                "s_admitted", request_id, f"slot={b} bucket={tb}",
+                dur_ns=int((time.perf_counter() - t_sub) * 1e9),
+            )
         done = (self.eos is not None and token == self.eos) or max_new <= 1
         if not done:
             self.slots[b] = _Slot(request_id, emitted=1, max_new=max_new)
@@ -177,6 +205,7 @@ class BatchEngine:
             self._imask = self._mask.astype(jnp.int32)
             self.positions = jnp.where(self._mask, self.positions, 0)
             self._members_dirty = False
+        t_step = time.perf_counter()
         nxt, self.caches = self.batch_step(
             self.tokens, self.caches, self.positions
         )
@@ -186,8 +215,15 @@ class BatchEngine:
         emitted = []
         import numpy as np
 
+        t_fetch = time.perf_counter()
         host = np.asarray(nxt)  # ONE device->host transfer for all slots
+        t_done = time.perf_counter()
         self.fetches += 1
+        if self.serving_metrics is not None:
+            self.serving_metrics.fetch_latency.observe(
+                (t_done - t_fetch) * 1e6
+            )
+        step_ns = int((t_done - t_step) * 1e9)
         for b, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -197,6 +233,14 @@ class BatchEngine:
                 slot.emitted >= slot.max_new
                 or (self.eos is not None and token == self.eos)
             )
+            if self.tracer is not None:
+                # The dense step is a 1-tick window: same span kind as
+                # the paged K-tick window so the timeline reads uniform.
+                self.tracer.span(
+                    "s_decode_window", slot.request_id,
+                    f"K=1 emitted=1 frozen_at={1 if done else None}",
+                    dur_ns=step_ns,
+                )
             emitted.append((slot.request_id, token, done))
             if done:
                 self.slots[b] = None
@@ -223,15 +267,44 @@ class PageAllocator:
         assert num_pages >= 2, num_pages
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))
+        #: high-water mark of pages in use (telemetry: a pool sized to
+        #: peak_in_use + headroom is the capacity-planning answer)
+        self.peak_in_use = 0
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def in_use(self) -> int:
+        """Pages currently granted (null page excluded)."""
+        return self.num_pages - 1 - len(self._free)
+
+    def largest_contiguous_free(self) -> int:
+        """Longest run of physically-adjacent free page ids — the
+        fragmentation gauge. Grants are id-scattered (block tables
+        indirect every access) so fragmentation never blocks a grant
+        here; the gauge exists because a future device-side contiguous
+        fast path would care, and because a collapsing value under
+        churn is the early signal. O(free) — called at snapshot
+        cadence, not on the grant path."""
+        if not self._free:
+            return 0
+        ids = sorted(self._free)
+        best = run = 1
+        for prev, cur in zip(ids, ids[1:]):
+            run = run + 1 if cur == prev + 1 else 1
+            if run > best:
+                best = run
+        return best
+
     def alloc(self, n: int) -> list[int] | None:
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        pages = [self._free.pop() for _ in range(n)]
+        if self.in_use > self.peak_in_use:
+            self.peak_in_use = self.in_use
+        return pages
 
     def free(self, pages: list[int]) -> None:
         self._free.extend(pages)
@@ -347,6 +420,19 @@ class PagedBatchEngine:
         #: (round-trip accounting behind tokens_per_dispatch)
         self.dispatches = 0
         self.fetches = 0
+        #: observability hooks (see BatchEngine): attached by the
+        #: serving node, None everywhere else — one attribute check per
+        #: hook site on the step path.
+        self.tracer = None
+        self.serving_metrics = None
+        #: request_id -> seconds its FIRST token sat host-side between
+        #: the final-chunk fetch and step() returning. The K-tick window
+        #: runs after the chunk inside the same step, so wall-clock TTFT
+        #: measured at the server is inflated by up to one whole window;
+        #: the server pops this lag and subtracts it (the PR-5 TTFT
+        #: quantization fix). Only fed while serving_metrics is attached,
+        #: so the dict stays empty for raw-engine tests/benches.
+        self.emit_lag_s: dict[str, float] = {}
 
         def _set_slot(tokens, positions, token, pos, b):
             tokens = jax.lax.dynamic_update_slice(
@@ -426,6 +512,14 @@ class PagedBatchEngine:
         self._decode[b] = False
         self._prefillq.append(b)
         self._bt_dirty = True
+        if self.serving_metrics is not None:
+            g = self.serving_metrics.grant_pages
+            g[len(pages)] = g.get(len(pages), 0) + 1
+        if self.tracer is not None:
+            self.tracer.span(
+                "s_admitted", request_id,
+                f"slot={b} pages={len(pages)}",
+            )
         return None
 
     def _free_slot(self, b: int) -> None:
@@ -449,8 +543,13 @@ class PagedBatchEngine:
         jnp = self._jnp
         np = self._np
         emitted: list[tuple[str, int, bool]] = []
+        sm = self.serving_metrics
+        #: (request_id, fetch time) of a first token emitted this step —
+        #: its host-side sit time until step() returns is the TTFT lag.
+        first_emit: tuple[str, float] | None = None
 
         if self._prefillq:
+            t_chunk = time.perf_counter()
             b = self._prefillq[0]
             s = self.slots[b]
             base = s.chunk_base
@@ -463,14 +562,20 @@ class PagedBatchEngine:
             s.chunk_base = base + self.chunk
             self.chunks_run += 1
             self.dispatches += 1
-            if s.chunk_base >= s.true_len:  # final chunk: stream starts
+            final_chunk = s.chunk_base >= s.true_len
+            if final_chunk:  # final chunk: stream starts
                 self._prefillq.popleft()
                 s.prompt = None
                 # Host-index AFTER a full [C] fetch — a device gather at
                 # a python index would compile one slice per distinct
                 # prompt-length remainder.
+                t_fetch = time.perf_counter()
                 token = int(np.asarray(greedy)[s.true_len - 1 - base])
+                t_first = time.perf_counter()
                 self.fetches += 1
+                if sm is not None:
+                    sm.fetch_latency.observe((t_first - t_fetch) * 1e6)
+                    first_emit = (s.request_id, t_first)
                 s.emitted = 1
                 done = (
                     self.eos is not None and token == self.eos
@@ -488,6 +593,16 @@ class PagedBatchEngine:
                     )
                     self._members_dirty = True
                     self._bt_dirty = True
+            if self.tracer is not None:
+                # Non-final chunks are async dispatches, so the span is
+                # dispatch cost only; the final chunk's span includes
+                # its blocking first-token fetch.
+                self.tracer.span(
+                    "s_prefill_chunk", s.request_id,
+                    f"base={base} chunk={self.chunk}"
+                    + (" final" if final_chunk else ""),
+                    dur_ns=int((time.perf_counter() - t_chunk) * 1e9),
+                )
 
         if any(self._decode):
             if self._members_dirty:
@@ -516,6 +631,7 @@ class PagedBatchEngine:
                     self._bt * np.asarray(self._decode, np.int32)[:, None]
                 )
                 self._bt_dirty = False
+            t_win = time.perf_counter()
             (
                 mat,
                 self.tokens,
@@ -528,8 +644,29 @@ class PagedBatchEngine:
                 self._mask, self._emitted_dev, self._maxnew_dev,
             )
             self.dispatches += 1
+            t_fetch = time.perf_counter()
             host = np.asarray(mat)  # ONE [B, K+1] device->host transfer
+            t_done = time.perf_counter()
             self.fetches += 1
+            if sm is not None:
+                sm.fetch_latency.observe((t_done - t_fetch) * 1e6)
+            if self.tracer is not None:
+                # Span per decoding stream BEFORE the unpack loop frees
+                # finished slots; all rows share the window's host span
+                # (one dispatch serves them all).
+                from dora_tpu.models.vlm import window_row_stats
+
+                win_ns = int((t_done - t_win) * 1e9)
+                for b, slot in enumerate(self.slots):
+                    if slot is None or not self._decode[b]:
+                        continue
+                    n_emit, frozen = window_row_stats(host[b], self.window)
+                    self.tracer.span(
+                        "s_decode_window", slot.request_id,
+                        f"K={self.window} emitted={n_emit} "
+                        f"frozen_at={frozen}",
+                        dur_ns=win_ns,
+                    )
             for b, slot in enumerate(self.slots):
                 if slot is None or not self._decode[b]:
                     continue
@@ -551,4 +688,67 @@ class PagedBatchEngine:
                     if done:
                         self._free_slot(b)
                         break
+        if first_emit is not None:
+            key, t_first = first_emit
+            self.emit_lag_s[key] = time.perf_counter() - t_first
         return emitted
+
+
+def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
+                           page_size: int = 8, chunk: int = 16,
+                           num_pages: int | None = None,
+                           eos: int | None = None, window: int = 1,
+                           vocab: int = 97, tick_sleep_s: float = 0.0):
+    """A weight-free :class:`PagedBatchEngine` over the REAL window
+    machinery: the decode window is ``vlm.make_paged_window`` (the same
+    ``lax.scan`` + ``freeze_inactive`` program serving runs) with the
+    model's batched step replaced by the affine token rule
+    ``next = (7*t + 3) % vocab``, applied identically by the chunk-
+    prefill stub — so token streams are deterministic, cheap to compile
+    on CPU, and identical across window sizes, while every scheduler
+    path (page grants, chunked prefill, mid-window freeze, slot free)
+    is the production code.
+
+    This is the engine the observability tests and the serving-trace
+    bench drive, and what a 3-process demo dataflow serves when no
+    checkpoint is available. ``tick_sleep_s`` adds a host sleep of
+    ``tick_sleep_s * window`` per decode window (after device sync) to
+    emulate per-tick device cost — the TTFT-quantization regression
+    test needs windows that measurably take K ticks."""
+    import jax
+    import jax.numpy as jnp
+
+    from dora_tpu.models.vlm import make_paged_window
+
+    if num_pages is None:
+        num_pages = max_slots * (max_seq // page_size) + 1
+
+    def step_fn(tokens, pools, positions, bts):
+        del positions, bts
+        return (tokens * 7 + 3) % vocab, pools
+
+    base_window = jax.jit(make_paged_window(step_fn, k=window, eos=eos))
+
+    def window_step(*args):
+        out = base_window(*args)
+        if tick_sleep_s:
+            jax.block_until_ready(out[0])
+            time.sleep(tick_sleep_s * window)
+        return out
+
+    chunk_fn = jax.jit(
+        lambda ids, pools, position, bt: ((ids * 7 + 3) % vocab, pools)
+    )
+
+    return PagedBatchEngine(
+        init_pool=lambda n: {"null": jnp.zeros((1,), jnp.int32)},
+        chunk_prefill=chunk_fn,
+        window_step=window_step,
+        max_slots=max_slots,
+        max_seq=max_seq,
+        page_size=page_size,
+        chunk=chunk,
+        num_pages=num_pages,
+        eos=eos,
+        window=window,
+    )

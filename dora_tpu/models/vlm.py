@@ -605,6 +605,22 @@ def make_paged_window(step_fn, *, k: int, eos: int | None = None):
     return window
 
 
+def window_row_stats(row, k: int) -> tuple[int, int | None]:
+    """Decode one stream's row of the window's ``[B, k+1]`` token matrix
+    into ``(emitted, frozen_at)``: how many real tokens the row emitted
+    this window and the tick index at which the device froze it (None if
+    it ran the full window). Columns past a row's completion hold the
+    ``-1`` sentinel; column ``k`` is the final active flag, not a token.
+    Host-side observability helper (engine span details, TTFT tick
+    offsets) — never traced."""
+    emitted = 0
+    for j in range(k):
+        if int(row[j]) < 0:
+            return emitted, j
+        emitted += 1
+    return emitted, (None if int(row[k]) else k)
+
+
 def generate_tp(params, tp_params, cfg: VLMConfig, images, prompt_ids,
                 max_new_tokens: int, mesh):
     """Greedy generation with the decode scan on the FUSED kernel tier

@@ -113,6 +113,8 @@ class Node:
         self._tracing = TRACING
         #: ring position already shipped to the daemon (ReportTrace)
         self._trace_cursor = 0
+        #: FLIGHT.dropped already turned into trace_truncated events
+        self._trace_dropped_sent = 0
         #: per-output published message/byte counters (node-local view;
         #: the daemon's metrics plane is authoritative for routed counts)
         self._send_counts: dict[str, list] = {}
@@ -483,10 +485,24 @@ class Node:
 
     def _queue_trace_report(self) -> None:
         """Queue ring growth since the last report as a fire-and-forget
-        ReportTrace (caller flushes the control channel)."""
+        ReportTrace (caller flushes the control channel). Ring wrap
+        between flushes is not silent: the loss ships as a synthetic
+        ``trace_truncated`` event (count in slot ``a``), so the export
+        shows WHERE the gap sits on the timeline, and it rides the
+        existing ReportTrace wire format unchanged."""
         events, self._trace_cursor = self._flight.events_since(
             self._trace_cursor
         )
+        dropped = self._flight.dropped
+        if dropped > self._trace_dropped_sent:
+            lost = dropped - self._trace_dropped_sent
+            self._trace_dropped_sent = dropped
+            events = [
+                (
+                    time.monotonic_ns(), time.time_ns(),
+                    "trace_truncated", lost, None, None,
+                )
+            ] + list(events)
         if events:
             self._control.queue(
                 n2d.ReportTrace(events=[list(e) for e in events])

@@ -94,25 +94,39 @@ class AdmissionQueue:
     capacity may have appeared — after a push, after an engine step
     freed slots/pages, and on the idle path — a parked request must
     never wait for unrelated traffic to trigger its admission
-    (regression: tests/test_llm_backlog.py)."""
+    (regression: tests/test_llm_backlog.py).
 
-    def __init__(self, engine, start):
+    ``on_admit(key, waited_s)`` (optional) fires just before a parked
+    request starts, with how long it sat in the backlog — the server
+    feeds the ``backlog_wait`` histogram and the ``queued`` lifecycle
+    span from it."""
+
+    def __init__(self, engine, start, on_admit=None, clock=time.monotonic):
         self._engine = engine
         self._start = start
-        self._q: list[tuple[str, list[int], int]] = []
+        self._on_admit = on_admit
+        self._clock = clock
+        self._q: list[tuple[str, list[int], int, float]] = []
 
     def __len__(self) -> int:
         return len(self._q)
 
+    def queued(self, key: str) -> bool:
+        """Is ``key`` still parked (pushed but not yet admitted)?"""
+        return any(entry[0] == key for entry in self._q)
+
     def push(self, key: str, ids: list[int], max_new: int) -> None:
-        self._q.append((key, ids, max_new))
+        self._q.append((key, ids, max_new, self._clock()))
         self.drain()
 
     def drain(self) -> None:
         while self._q and self._engine.can_admit(
             len(self._q[0][1]), self._q[0][2]
         ):
-            self._start(*self._q.pop(0))
+            key, ids, max_new, t_in = self._q.pop(0)
+            if self._on_admit is not None:
+                self._on_admit(key, self._clock() - t_in)
+            self._start(key, ids, max_new)
 
 
 def _run_loop(node, engine, backlog, metrics, handle_input, emit,
@@ -162,15 +176,208 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
             report_last = now
 
 
+def serve(node, engine, metrics, *, encode, decode_one, eos=None,
+          max_new_cap=32, tracer=None, clock=time.monotonic) -> None:
+    """Run the serving loop over an already-built engine until the
+    input stream ends, then close the node. Factored out of
+    :func:`main` (which only adds checkpoint loading) so tests and
+    demo dataflows can serve a stub engine through the REAL admission /
+    backlog / lifecycle-tracing paths.
+
+    Attaches the observability plane: a ``ServingTracer`` shared with
+    the engine (request-lifecycle spans through the flight-recorder
+    ring, linked to the carrier message's trace context), the
+    ``ServingMetrics`` histograms the engine feeds (fetch latency,
+    grant sizes), and the runtime XLA compile listener whose counter
+    ships with every metrics report."""
+    from dora_tpu import telemetry
+
+    if tracer is None:
+        tracer = telemetry.ServingTracer()
+    # The engine records admitted/prefill_chunk/decode_window spans and
+    # fetch/grant histograms through these hooks; both are no-ops /
+    # plain counters unless DORA_TRACING=1.
+    engine.tracer = tracer
+    engine.serving_metrics = metrics
+    telemetry.install_compile_listener()
+    paged = hasattr(engine, "free_pages")
+    #: engine key -> wire request_id. The ENGINE key is always unique
+    #: (req-N): two in-flight requests carrying the same wire
+    #: ``request_id`` must not share a slot key, or their token streams
+    #: silently interleave — the wire id is carried separately and only
+    #: stamped on the outgoing chunks.
+    wire_ids: dict[str, str | None] = {}
+    #: engine key -> arrival wall time, pending first token (TTFT)
+    t_admitted: dict[str, float] = {}
+    req_counter = [0]
+
+    def emit_text(
+        key: str, text: str, done: bool, finish: str | None = None
+    ) -> None:
+        meta: dict = {"done": bool(done)}
+        if done:
+            # Done-by-EOS ("stop") vs done-by-cap ("length"): the server
+            # reports this as the OpenAI finish_reason.
+            meta["finish"] = finish or "stop"
+        rid = wire_ids.get(key)
+        if rid is not None:
+            meta["request_id"] = rid
+        t0 = t_admitted.pop(key, None)
+        if t0 is not None:
+            # The paged engine runs its K-tick window AFTER the prefill
+            # chunk that produced this first token, inside the same
+            # step() — the token sat host-side for up to a whole window
+            # before the loop could emit it. The engine measured that
+            # sit time (emit_lag_s); subtracting it recovers sub-window
+            # TTFT instead of quantizing to window granularity.
+            lag = engine.emit_lag_s.pop(key, 0.0) if hasattr(
+                engine, "emit_lag_s"
+            ) else 0.0
+            metrics.ttft.observe(max(0.0, clock() - t0 - lag) * 1e6)
+        node.send_output("response", pa.array([text]), meta)
+        if done:
+            wire_ids.pop(key, None)
+            tracer.finish(key, finish or "stop")
+
+    def emit(key: str, token: int, done: bool) -> None:
+        finish = None
+        if done:
+            finish = "stop" if (eos is not None and token == eos) else "length"
+        metrics.decode_tokens += 1
+        emit_text(key, decode_one(token), done, finish)
+
+    def on_admit(key: str, waited_s: float) -> None:
+        metrics.backlog_wait.observe(waited_s * 1e6)
+        # The queued span closes at admission; the exporter derives its
+        # start from the duration, so it covers the whole backlog wait.
+        tracer.span("s_queued", key, dur_ns=int(waited_s * 1e9))
+
+    def start(key: str, ids: list[int], max_new: int) -> None:
+        res = engine.submit(key, ids, max_new)
+        if res is not None:  # dense engine: first token is synchronous
+            emit(key, *res)
+        # paged engine: submit queues the prefill; the first token is
+        # emitted by a later step() when the final chunk lands.
+
+    #: requests that arrived while the engine couldn't admit them
+    backlog = AdmissionQueue(engine, start, on_admit=on_admit, clock=clock)
+
+    def handle_input(event) -> None:
+        from dora_tpu.telemetry import OTEL_CTX_KEY
+
+        meta = event.get("metadata") or {}
+        rid = meta.get("request_id")
+        value = event["value"]
+        text = (
+            value.to_pylist()[0]
+            if isinstance(value, pa.Array)
+            else bytes(value or b"").decode(errors="replace")
+        )
+        req_counter[0] += 1
+        key = f"req-{req_counter[0]}"
+        wire_ids[key] = rid
+        metrics.requests += 1
+        # Engine spans join the trace of the message that carried the
+        # request in — one trace id covers send → route → deliver →
+        # queued → admitted → … → finish.
+        tracer.begin(key, str(meta.get(OTEL_CTX_KEY, "") or ""))
+        ids = encode(text) or [0]
+        max_new = min(
+            int(meta.get("max_new_tokens", max_new_cap)),
+            max_new_cap,
+        )
+        if max_new <= 0:
+            # max_tokens <= 0 asks for nothing: close the stream
+            # empty instead of fabricating a token.
+            metrics.rejected += 1
+            tracer.instant("s_reject", key, "max_new<=0")
+            emit_text(key, "", True, finish="length")
+        elif not engine.fits(len(ids), max_new):
+            # Oversized: close the stream empty — never fabricate a
+            # token as a "successful" answer.
+            metrics.rejected += 1
+            tracer.instant("s_reject", key, f"oversized len={len(ids)}")
+            emit_text(key, "", True, finish="length")
+        else:
+            t_admitted[key] = clock()
+            backlog.push(key, ids, max_new)  # push drains: admits now
+            # when the engine can, else parks until capacity frees
+            if backlog.queued(key):
+                # Parked: no slot, or the page pool couldn't cover the
+                # grant — the preempt-free backlog wait begins here.
+                tracer.instant(
+                    "s_page_wait", key,
+                    f"backlog={len(backlog)} "
+                    f"free_pages={getattr(engine, 'free_pages', 0)}",
+                )
+
+    def report(now: float) -> None:
+        metrics.slots_active = engine.active
+        metrics.slots_total = engine.max_slots
+        metrics.backlog_depth = len(backlog)
+        metrics.prefill_chunks = getattr(engine, "chunks_run", 0)
+        metrics.host_dispatches = getattr(engine, "dispatches", 0)
+        metrics.host_fetches = getattr(engine, "fetches", 0)
+        metrics.compiles = telemetry.compile_count()
+        if paged:
+            metrics.free_pages = engine.free_pages
+            alloc = getattr(engine, "allocator", None)
+            if alloc is not None:
+                metrics.total_pages = alloc.num_pages - 1
+                metrics.used_pages = alloc.in_use
+                metrics.peak_used_pages = alloc.peak_in_use
+                metrics.largest_contig_free = (
+                    alloc.largest_contiguous_free()
+                )
+        try:
+            node.report_serving(metrics.snapshot())
+        except Exception:
+            pass  # metrics are best-effort; serving never blocks on them
+
+    try:
+        _run_loop(
+            node, engine, backlog, metrics, handle_input, emit, report,
+            clock=clock,
+        )
+    finally:
+        report(clock())
+        node.close()
+
+
+def _stub_main() -> None:
+    """Serve the weight-free stub engine (``DORA_STUB_ENGINE=1``): the
+    real admission / backlog / lifecycle-tracing / reporting paths over
+    ``models.batch_engine.make_stub_paged_engine`` — what the
+    observability e2e test and the serving-trace demo dataflow run when
+    no checkpoint is available. Tokens are the stub's deterministic
+    affine chain rendered as ``t<id>`` words, not language."""
+    from dora_tpu.metrics import ServingMetrics
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    engine = make_stub_paged_engine(
+        max_slots=int(os.environ.get("DORA_BATCH_SLOTS", "4")),
+        window=int(os.environ.get("DORA_MULTISTEP_K", "4")),
+    )
+    serve(
+        Node(), engine, ServingMetrics(engine="paged"),
+        encode=lambda text: [ord(ch) % 97 for ch in text] or [1],
+        decode_one=lambda t: f" t{t}",
+        max_new_cap=int(os.environ.get("DORA_MAX_NEW_TOKENS", "8")),
+    )
+
+
 def main() -> None:
     from dora_tpu.metrics import ServingMetrics
     from dora_tpu.models.hf import qwen2
 
     path = os.environ.get("DORA_HF_CHECKPOINT")
     if not path:
+        if os.environ.get("DORA_STUB_ENGINE", "") not in ("", "0"):
+            return _stub_main()
         raise RuntimeError(
             "llm_server needs DORA_HF_CHECKPOINT (a Qwen2-family "
-            "safetensors directory)"
+            "safetensors directory; or DORA_STUB_ENGINE=1 for the "
+            "weight-free stub engine)"
         )
     max_seq = int(os.environ.get("DORA_MAX_SEQ", "2048"))
     max_new_cap = int(os.environ.get("DORA_MAX_NEW_TOKENS", "32"))
@@ -207,107 +414,14 @@ def main() -> None:
         return tokenizer.decode([token])
 
     engine = make_engine(params, cfg, eos=eos)
-    paged = hasattr(engine, "free_pages")
-    metrics = ServingMetrics(engine="paged" if paged else "dense")
-    node = Node()
-    #: engine key -> wire request_id. The ENGINE key is always unique
-    #: (req-N): two in-flight requests carrying the same wire
-    #: ``request_id`` must not share a slot key, or their token streams
-    #: silently interleave — the wire id is carried separately and only
-    #: stamped on the outgoing chunks.
-    wire_ids: dict[str, str | None] = {}
-    #: engine key -> admission wall time, pending first token (TTFT)
-    t_admitted: dict[str, float] = {}
-    req_counter = [0]
-
-    def emit_text(
-        key: str, text: str, done: bool, finish: str | None = None
-    ) -> None:
-        meta: dict = {"done": bool(done)}
-        if done:
-            # Done-by-EOS ("stop") vs done-by-cap ("length"): the server
-            # reports this as the OpenAI finish_reason.
-            meta["finish"] = finish or "stop"
-        rid = wire_ids.get(key)
-        if rid is not None:
-            meta["request_id"] = rid
-        t0 = t_admitted.pop(key, None)
-        if t0 is not None:
-            metrics.ttft.observe((time.monotonic() - t0) * 1e6)
-        node.send_output("response", pa.array([text]), meta)
-        if done:
-            wire_ids.pop(key, None)
-
-    def emit(key: str, token: int, done: bool) -> None:
-        finish = None
-        if done:
-            finish = "stop" if (eos is not None and token == eos) else "length"
-        metrics.decode_tokens += 1
-        emit_text(key, decode_one(token), done, finish)
-
-    def start(key: str, ids: list[int], max_new: int) -> None:
-        res = engine.submit(key, ids, max_new)
-        if res is not None:  # dense engine: first token is synchronous
-            emit(key, *res)
-        # paged engine: submit queues the prefill; the first token is
-        # emitted by a later step() when the final chunk lands.
-
-    #: requests that arrived while the engine couldn't admit them
-    backlog = AdmissionQueue(engine, start)
-
-    def handle_input(event) -> None:
-        meta = event.get("metadata") or {}
-        rid = meta.get("request_id")
-        value = event["value"]
-        text = (
-            value.to_pylist()[0]
-            if isinstance(value, pa.Array)
-            else bytes(value or b"").decode(errors="replace")
-        )
-        req_counter[0] += 1
-        key = f"req-{req_counter[0]}"
-        wire_ids[key] = rid
-        metrics.requests += 1
-        ids = encode(text) or [0]
-        max_new = min(
-            int(meta.get("max_new_tokens", max_new_cap)),
-            max_new_cap,
-        )
-        if max_new <= 0:
-            # max_tokens <= 0 asks for nothing: close the stream
-            # empty instead of fabricating a token.
-            metrics.rejected += 1
-            emit_text(key, "", True, finish="length")
-        elif not engine.fits(len(ids), max_new):
-            # Oversized: close the stream empty — never fabricate a
-            # token as a "successful" answer.
-            metrics.rejected += 1
-            emit_text(key, "", True, finish="length")
-        else:
-            t_admitted[key] = time.monotonic()
-            backlog.push(key, ids, max_new)  # push drains: admits now
-            # when the engine can, else parks until capacity frees
-
-    def report(now: float) -> None:
-        metrics.slots_active = engine.active
-        metrics.slots_total = engine.max_slots
-        metrics.backlog_depth = len(backlog)
-        metrics.prefill_chunks = getattr(engine, "chunks_run", 0)
-        metrics.host_dispatches = getattr(engine, "dispatches", 0)
-        metrics.host_fetches = getattr(engine, "fetches", 0)
-        if paged:
-            metrics.free_pages = engine.free_pages
-            metrics.total_pages = engine.allocator.num_pages - 1
-        try:
-            node.report_serving(metrics.snapshot())
-        except Exception:
-            pass  # metrics are best-effort; serving never blocks on them
-
-    try:
-        _run_loop(node, engine, backlog, metrics, handle_input, emit, report)
-    finally:
-        report(time.monotonic())
-        node.close()
+    metrics = ServingMetrics(
+        engine="paged" if hasattr(engine, "free_pages") else "dense"
+    )
+    serve(
+        Node(), engine, metrics,
+        encode=encode, decode_one=decode_one, eos=eos,
+        max_new_cap=max_new_cap,
+    )
 
 
 if __name__ == "__main__":

@@ -159,18 +159,24 @@ class FlightRecorder:
 
     Recording stays lock-free; readers (:meth:`events`,
     :meth:`events_since`) snapshot defensively and drop slots a
-    concurrent writer may have overwritten mid-copy. The ring is dumped
-    on SIGUSR2 alongside the asyncio task dump (daemons) or via
-    :func:`install_flight_dump` (nodes).
+    concurrent writer may have overwritten mid-copy. Saturation is NOT
+    silent: ``dropped`` counts events that wrapped out of the ring
+    before the incremental reader shipped them, and the node flusher
+    turns growth of that counter into a ``trace_truncated`` event on
+    the timeline. The ring is dumped on SIGUSR2 alongside the asyncio
+    task dump (daemons) or via :func:`install_flight_dump` (nodes).
     """
 
-    __slots__ = ("enabled", "_slots", "_size", "_idx")
+    __slots__ = ("enabled", "dropped", "_slots", "_size", "_idx")
 
     def __init__(self, size: int = 4096, enabled: bool = False):
         self._size = max(1, size)
         self._slots = [[0, 0, "", None, None, None] for _ in range(self._size)]
         self._idx = 0
         self.enabled = enabled
+        #: events overwritten before :meth:`events_since` could ship
+        #: them (ring wrap between incremental reads)
+        self.dropped = 0
 
     def configure_from_env(self) -> None:
         """Re-read the env knobs (daemons/nodes call this at startup, so
@@ -225,12 +231,18 @@ class FlightRecorder:
     def events_since(self, cursor: int) -> tuple[list[tuple], int]:
         """Events recorded since ``cursor`` (a previous return value; 0
         to start) plus the new cursor — the incremental-shipping API the
-        node flusher uses to stream ring growth to its daemon."""
+        node flusher uses to stream ring growth to its daemon. Events
+        that wrapped out between reads are gone; they are COUNTED
+        (``dropped``) so saturation is observable, not silent."""
         idx = self._idx
-        return self._snapshot(max(cursor, idx - min(idx, self._size))), idx
+        floor = idx - min(idx, self._size)
+        if cursor < floor:
+            self.dropped += floor - cursor
+        return self._snapshot(max(cursor, floor)), idx
 
     def clear(self) -> None:
         self._idx = 0
+        self.dropped = 0
         for slot in self._slots:
             slot[0] = 0
             slot[1] = 0
@@ -246,7 +258,7 @@ class FlightRecorder:
         events = self.events()
         print(
             f"--- flight recorder ({len(events)} events, "
-            f"{self._idx} recorded total)",
+            f"{self._idx} recorded total, {self.dropped} dropped)",
             file=file,
         )
         for mono, _wall, kind, a, b, c in events:
@@ -264,6 +276,135 @@ FLIGHT = FlightRecorder(
         or os.environ.get("DORA_TRACING", "") not in ("", "0")
     ),
 )
+
+
+# ---------------------------------------------------------------------------
+# serving-engine lifecycle tracer (request spans on the cluster timeline)
+# ---------------------------------------------------------------------------
+
+
+class ServingTracer:
+    """Per-request lifecycle spans for the serving engine, recorded
+    through the flight-recorder ring.
+
+    One instance per serving process, shared between the server loop
+    (``nodehub/llm_server``: queued / finish / reject / page-wait) and
+    the engine (``models/batch_engine``: admitted / prefill_chunk /
+    decode_window) via ``engine.tracer``. Slot discipline matches the
+    message plane: ``a`` = request key (+ detail), ``b`` = the
+    request's serialized trace context, ``c`` = span duration in ns —
+    so ``tracing.to_chrome_trace`` links the whole chain by one trace
+    id on the per-process ENGINE track.
+
+    :meth:`begin` derives the request context from the arriving
+    message's ``open_telemetry_context`` when present, so engine spans
+    share the trace id of the message-plane ``send → route → deliver →
+    recv`` chain that carried the request in. Every method is one
+    attribute check when tracing is off — engines keep a tracer
+    attached unconditionally and pay ~0 without ``DORA_TRACING=1``.
+    """
+
+    __slots__ = ("_flight", "_tracing", "_ctx")
+
+    def __init__(self, flight: FlightRecorder | None = None,
+                 tracing: TracingState | None = None):
+        self._flight = flight if flight is not None else FLIGHT
+        self._tracing = tracing if tracing is not None else TRACING
+        #: request key -> serialized trace context, begin() .. finish()
+        self._ctx: dict[str, str] = {}
+
+    @property
+    def active(self) -> bool:
+        return self._tracing.active
+
+    def begin(self, key: str, parent_ctx: str = "") -> None:
+        """Open a request's trace context (same trace id as the carrier
+        message when ``parent_ctx`` holds its serialized context)."""
+        if not self._tracing.active:
+            return
+        self._ctx[key] = child_context(parent_ctx)
+
+    def span(self, kind: str, key: str, detail: str | None = None,
+             dur_ns: int = 0) -> None:
+        """One completed lifecycle span (recorded at END; the exporter
+        derives the start from ``wall - dur`` like the message plane)."""
+        if not self._tracing.active:
+            return
+        self._flight.record(
+            kind, f"{key} {detail}" if detail else key,
+            self._ctx.get(key), int(dur_ns),
+        )
+
+    def instant(self, kind: str, key: str, detail: str | None = None) -> None:
+        """A point event on the engine track (admission reject,
+        page-grant failure, preempt-free backlog wait)."""
+        if not self._tracing.active:
+            return
+        self._flight.record(
+            kind, f"{key} {detail}" if detail else key,
+            self._ctx.get(key), None,
+        )
+
+    def finish(self, key: str, reason: str = "stop") -> None:
+        """Close a request: records ``s_finish`` and releases its
+        context (the dict must not grow with request count)."""
+        ctx = self._ctx.pop(key, None)
+        if not self._tracing.active:
+            return
+        self._flight.record("s_finish", f"{key} {reason}", ctx, 0)
+
+
+# ---------------------------------------------------------------------------
+# XLA compile audit (runtime promotion of the tier-1 compile listener)
+# ---------------------------------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_state = {"count": 0, "installed": False}
+
+
+def install_compile_listener() -> bool:
+    """Stamp every XLA backend compile onto the timeline.
+
+    The zero-steady-state-recompile invariant (paged engine: exactly
+    one program per closure, tests/test_paged_engine.py) was only
+    observable under pytest; this promotes the same jax monitoring hook
+    into runtime telemetry: each compile records an ``xla_compile``
+    instant in the flight-recorder ring (elapsed ns; the traced
+    callable's name when jax provides it) and bumps a process-wide
+    counter that ``ServingMetrics`` ships to ``dora-tpu metrics`` — a
+    nonzero delta while serving steady traffic IS the regression.
+
+    Idempotent; returns False when jax's monitoring hook is
+    unavailable (no jax, or an incompatible internal API)."""
+    if _compile_state["installed"]:
+        return True
+    try:
+        from jax._src import monitoring
+    except Exception:
+        return False
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        _compile_state["count"] += 1
+        FLIGHT.record(
+            "xla_compile",
+            str(kwargs.get("fun_name", "") or "backend_compile"),
+            None,
+            int(duration * 1e9),
+        )
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _compile_state["installed"] = True
+    return True
+
+
+def compile_count() -> int:
+    """XLA backend compiles observed since :func:`install_compile_listener`."""
+    return _compile_state["count"]
 
 
 def install_flight_dump() -> None:

@@ -24,9 +24,15 @@ Model: ``DORA_HF_CHECKPOINT`` when set (real numbers on the TPU box);
 otherwise a tiny random Qwen2 is built in-process and the numbers are
 relative-only (CPU smoke A/B, same code path).
 
+A fourth axis behind ``--trace-ab``: the 16-stream paged run with the
+serving observability plane attached, tracing off vs on (interleaved),
+reporting the wall-clock overhead of the request-lifecycle span
+records — the serving counterpart of bench.py's recorder A/B gate
+(≤3%).
+
 Usage::
 
-    python -m dora_tpu.tools.bench_serving [--multistep]
+    python -m dora_tpu.tools.bench_serving [--multistep | --trace-ab]
 """
 
 from __future__ import annotations
@@ -172,6 +178,77 @@ def _multistep_sweep(qwen2, path: str, real: bool) -> dict:
     return out
 
 
+def _trace_ab(qwen2, path: str, real: bool) -> dict:
+    """Serving-span instrumentation overhead: the 16-stream paged run
+    with the full observability plane attached (ServingTracer +
+    ServingMetrics on the engine, lifecycle spans through the
+    flight-recorder ring) A/B'd tracing-off vs tracing-on, trials
+    interleaved so both sides see the same machine conditions — the
+    recorder-A/B methodology from bench.py's message-plane legs applied
+    to the engine step path. Both sides carry the tracer and metrics
+    objects; the off side pays exactly what production pays without
+    ``DORA_TRACING=1`` (one attribute check per hook site), so
+    ``overhead_pct`` isolates the span records themselves."""
+    import numpy as np
+
+    from dora_tpu import telemetry
+    from dora_tpu.metrics import ServingMetrics
+
+    if real:
+        max_seq = int(os.environ.get("DORA_MAX_SEQ", "512"))
+        page_size, chunk, plen, max_new = 16, 64, 64, 32
+    else:
+        max_seq, page_size, chunk, plen, max_new = 64, 8, 8, 4, 8
+
+    cfg, params = qwen2.load(path, max_seq=max_seq)
+    os.environ.setdefault("DORA_INT8_DECODE", "1")
+    params = qwen2.quantize_decode(params, cfg)
+    rng = np.random.default_rng(7)
+
+    def prompts(n: int) -> list[list[int]]:
+        return [
+            rng.integers(0, cfg.vocab, size=plen).tolist() for _ in range(n)
+        ]
+
+    engine = qwen2.make_paged_engine(
+        params, cfg, max_slots=16, page_size=page_size, chunk=chunk
+    )
+    engine.serving_metrics = ServingMetrics("paged")
+    tracer = telemetry.ServingTracer()
+    engine.tracer = tracer
+    _serve(engine, prompts(16), max_new)  # warmup: compiles only
+    trials = int(os.environ.get("DORA_BENCH_TRIALS", "5"))
+    walls: dict[str, list[float]] = {"off": [], "on": []}
+    span_events = 0
+    for _ in range(trials):
+        for mode in ("off", "on"):
+            on = mode == "on"
+            telemetry.TRACING.active = on
+            telemetry.FLIGHT.enabled = on
+            telemetry.FLIGHT.clear()
+            for i in range(16):
+                tracer.begin(str(i))
+            _tokens, wall, _ = _serve(engine, prompts(16), max_new)
+            for i in range(16):
+                tracer.finish(str(i))
+            if on:
+                span_events = len(telemetry.FLIGHT.events())
+            walls[mode].append(wall)
+    telemetry.TRACING.active = False
+    telemetry.FLIGHT.enabled = False
+    off_w = statistics.median(walls["off"])
+    on_w = statistics.median(walls["on"])
+    return {
+        "off_wall_s": round(off_w, 4),
+        "on_wall_s": round(on_w, 4),
+        "overhead_pct": (
+            round((on_w - off_w) / off_w * 100, 2) if off_w else None
+        ),
+        "span_events_per_run": span_events,
+        "trials": trials,
+    }
+
+
 def main() -> int:
     import numpy as np
 
@@ -185,6 +262,9 @@ def main() -> int:
         path = _tiny_checkpoint(tmp)
     if "--multistep" in sys.argv[1:]:
         print(json.dumps({"multistep": _multistep_sweep(qwen2, path, real)}))
+        return 0
+    if "--trace-ab" in sys.argv[1:]:
+        print(json.dumps({"trace_ab": _trace_ab(qwen2, path, real)}))
         return 0
     # Workload scales with the model: the real box gets 64-token prompts
     # and 32 new tokens inside the default (dense-4-footprint) pool; the

@@ -44,16 +44,43 @@ SPAN_KINDS = {
     "t_recv": "recv",
 }
 
+#: Serving-engine request-lifecycle span kinds (telemetry.ServingTracer
+#: + models/batch_engine) -> span name prefix. Same slot discipline as
+#: SPAN_KINDS (``b`` = per-request trace context, ``c`` = dur ns) but
+#: exported on the per-process ENGINE track (tid 1) in cat "serving":
+#: queued(backlog wait) → admitted(page grant) → prefill_chunk[i] →
+#: decode_window[j] → finish(reason).
+SERVING_SPAN_KINDS = {
+    "s_queued": "queued",
+    "s_admitted": "admitted",
+    "s_prefill_chunk": "prefill_chunk",
+    "s_decode_window": "decode_window",
+    "s_finish": "finish",
+}
+
 #: Hot-path flight events surfaced as instants (everything else recorded
 #: in the ring also exports as an instant, generically named).
 INSTANT_NAMES = {
     "drop_oldest": "drop oldest",
     "coalesce_flush": "coalesce flush",
     "fastroute_fallback": "fastroute fallback",
+    "s_reject": "admission reject",
+    "s_page_wait": "page wait",
+    "xla_compile": "xla compile",
+    "trace_truncated": "trace truncated",
 }
+
+#: Instants that belong on the engine track and may carry a request
+#: trace context in ``b`` (linked into the lifecycle chain by args).
+_ENGINE_INSTANTS = {"s_reject", "s_page_wait", "xla_compile"}
+
+#: Chrome-trace tid of the serving-engine track within a process (tid 0
+#: is the message plane).
+ENGINE_TID = 1
 
 _VALID_PH = {"X", "i", "M"}
 _VALID_SCOPES = {"g", "p", "t"}
+_VALID_SPAN_CATS = {"message", "serving"}
 
 
 def merge_trace_snapshots(snapshots: list[dict | None]) -> dict:
@@ -62,11 +89,16 @@ def merge_trace_snapshots(snapshots: list[dict | None]) -> dict:
     Each snapshot is ``Daemon.trace_snapshot`` output::
 
         {"machine": str, "wall_ns": int, "hlc_ns": int,
-         "processes": {process_name: [[mono, wall, kind, a, b, c], ...]}}
+         "processes": {process_name: [[mono, wall, kind, a, b, c], ...]},
+         "dropped_events": {process_name: int}}   # optional
 
-    Returns ``{"processes": [{"machine", "process", "events"}, ...]}``
-    with every event's wall stamp shifted by that machine's
-    ``hlc_ns - wall_ns`` offset onto the cluster HLC timeline.
+    Returns ``{"processes": [{"machine", "process", "events",
+    "dropped_events"}, ...]}`` with every event's wall stamp shifted by
+    that machine's ``hlc_ns - wall_ns`` offset onto the cluster HLC
+    timeline. ``dropped_events`` (events the daemon's per-node buffer
+    cap trimmed before this snapshot; ring-level drops ride along as
+    ``trace_truncated`` events) is carried per process so the export
+    can mark truncated tracks.
     """
     processes: list[dict] = []
     for snap in snapshots:
@@ -74,6 +106,7 @@ def merge_trace_snapshots(snapshots: list[dict | None]) -> dict:
             continue
         offset = int(snap.get("hlc_ns", 0)) - int(snap.get("wall_ns", 0))
         machine = str(snap.get("machine", "?"))
+        dropped = snap.get("dropped_events") or {}
         for process, events in sorted(snap["processes"].items()):
             aligned = []
             for e in events:
@@ -84,7 +117,12 @@ def merge_trace_snapshots(snapshots: list[dict | None]) -> dict:
                 aligned.append(e)
             aligned.sort(key=lambda e: e[WALL])
             processes.append(
-                {"machine": machine, "process": process, "events": aligned}
+                {
+                    "machine": machine,
+                    "process": process,
+                    "events": aligned,
+                    "dropped_events": int(dropped.get(process, 0)),
+                }
             )
     processes.sort(key=lambda p: (p["machine"], p["process"]))
     return {"processes": processes}
@@ -106,7 +144,13 @@ def to_chrome_trace(merged: dict) -> dict:
     One pid per (machine, process) with an ``M`` process_name record; a
     ``ph:"X"`` complete span per message-plane record whose ``ts`` is the
     span start (wall stamp is taken at record time = span end, so start =
-    wall - dur); ``ph:"i"`` instants for everything else. Timestamps are
+    wall - dur); ``ph:"i"`` instants for everything else. Serving-engine
+    lifecycle records (SERVING_SPAN_KINDS + engine instants) land on a
+    separate ENGINE track (tid 1, named via a thread_name meta) inside
+    the same process pid, cat "serving", so Perfetto shows the request
+    chain under the process that served it. A process whose events were
+    truncated (daemon buffer cap, ``dropped_events`` from the merge)
+    opens with a ``trace truncated`` instant. Timestamps are
     microseconds (floats), rebased to the earliest event so Perfetto's
     axis starts near zero.
     """
@@ -127,25 +171,76 @@ def to_chrome_trace(merged: dict) -> dict:
                 "args": {"name": track},
             }
         )
+        if any(
+            e[KIND] in SERVING_SPAN_KINDS or e[KIND] in _ENGINE_INSTANTS
+            for e in proc["events"]
+        ):
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": ENGINE_TID,
+                    "args": {"name": "engine"},
+                }
+            )
+        dropped = int(proc.get("dropped_events", 0) or 0)
+        if dropped > 0:
+            first_us = (
+                (proc["events"][0][WALL] - base_ns) / 1000.0
+                if proc["events"]
+                else 0.0
+            )
+            events.append(
+                {
+                    "name": f"trace truncated ({dropped} events lost)",
+                    "ph": "i",
+                    "ts": max(0.0, first_us),
+                    "pid": pid,
+                    "tid": 0,
+                    "s": "p",
+                    "cat": "flight",
+                }
+            )
         for e in proc["events"]:
             kind = e[KIND]
             wall_us = (e[WALL] - base_ns) / 1000.0
-            if kind in SPAN_KINDS:
+            if kind in SPAN_KINDS or kind in SERVING_SPAN_KINDS:
+                serving = kind in SERVING_SPAN_KINDS
+                name = (SERVING_SPAN_KINDS if serving else SPAN_KINDS)[kind]
                 dur_us = max(0, int(e[C] or 0)) / 1000.0
                 events.append(
                     {
-                        "name": f"{SPAN_KINDS[kind]} {e[A]}",
+                        "name": f"{name} {e[A]}",
                         "ph": "X",
                         "ts": max(0.0, wall_us - dur_us),
                         "dur": dur_us,
                         "pid": pid,
-                        "tid": 0,
-                        "cat": "message",
+                        "tid": ENGINE_TID if serving else 0,
+                        "cat": "serving" if serving else "message",
                         "args": _span_args(e[B]),
                     }
                 )
             else:
                 name = INSTANT_NAMES.get(kind, kind)
+                if kind in _ENGINE_INSTANTS:
+                    # Engine instants carry the request context in b:
+                    # link them into the lifecycle chain, not the label.
+                    extra = str(e[A]) if e[A] is not None else ""
+                    ev = {
+                        "name": f"{name} {extra}".rstrip(),
+                        "ph": "i",
+                        "ts": max(0.0, wall_us),
+                        "pid": pid,
+                        "tid": ENGINE_TID,
+                        "s": "p",
+                        "cat": "serving",
+                    }
+                    args = _span_args(e[B])
+                    if args:
+                        ev["args"] = args
+                    events.append(ev)
+                    continue
                 extra = " ".join(str(x) for x in (e[A], e[B]) if x is not None)
                 events.append(
                     {
@@ -199,6 +294,25 @@ def validate_chrome_trace(trace: Any) -> list[str]:
                 or dur < 0
             ):
                 errors.append(f"{where}: dur missing, non-numeric, or negative")
+            cat = ev.get("cat")
+            if cat not in _VALID_SPAN_CATS:
+                errors.append(
+                    f"{where}: span cat {cat!r} not one of "
+                    f"{sorted(_VALID_SPAN_CATS)}"
+                )
+            elif cat == "serving":
+                # Engine lifecycle spans: engine track, known taxonomy.
+                if ev.get("tid") != ENGINE_TID:
+                    errors.append(
+                        f"{where}: serving span on tid {ev.get('tid')!r}, "
+                        f"expected engine tid {ENGINE_TID}"
+                    )
+                prefix = str(ev.get("name", "")).split(" ", 1)[0]
+                if prefix not in SERVING_SPAN_KINDS.values():
+                    errors.append(
+                        f"{where}: serving span name {ev.get('name')!r} "
+                        "outside the lifecycle taxonomy"
+                    )
         if ph == "i" and ev.get("s") not in _VALID_SCOPES:
             errors.append(f"{where}: instant scope s {ev.get('s')!r} invalid")
     return errors
@@ -206,7 +320,11 @@ def validate_chrome_trace(trace: Any) -> list[str]:
 
 def _sample_snapshots() -> list[dict]:
     """Two synthetic machine snapshots with deliberate clock skew — the
-    offline input for :func:`self_check`."""
+    offline input for :func:`self_check`. Machine B also hosts a
+    serving process with a full request-lifecycle chain (one request
+    context), an engine instant, a ring ``trace_truncated`` event, and
+    a daemon-side ``dropped_events`` count, so the self-check covers
+    the engine track end to end."""
     ctx = "traceparent:00-000102030405060708090a0b0c0d0e0f-0001020304050607-01;"
     base = 1_700_000_000_000_000_000
     # Machine A's wall clock lags the cluster HLC by 5 ms.
@@ -226,7 +344,10 @@ def _sample_snapshots() -> list[dict]:
             ],
         },
     }
-    # Machine B's wall clock runs 2 ms ahead of the cluster HLC.
+    # Machine B's wall clock runs 2 ms ahead of the cluster HLC. The
+    # serving chain shares the message chain's trace id (the tracer
+    # derives the request context from the delivered message).
+    rctx = "traceparent:00-000102030405060708090a0b0c0d0e0f-1111020304050607-01;"
     b = {
         "machine": "B",
         "wall_ns": base + 2_000_000,
@@ -238,7 +359,18 @@ def _sample_snapshots() -> list[dict]:
                 [30, base + 8_500_000, "t_recv", "in", ctx, 0],
                 [31, base + 8_600_000, "fastroute_fallback", "decode", None, None],
             ],
+            "llm": [
+                [40, base + 8_700_000, "trace_truncated", 17, None, None],
+                [41, base + 8_900_000, "s_queued", "req-1", rctx, 100_000],
+                [42, base + 9_000_000, "s_admitted", "req-1 pages=2", rctx, 20_000],
+                [43, base + 9_300_000, "s_prefill_chunk", "req-1 base=0", rctx, 200_000],
+                [44, base + 9_800_000, "s_decode_window", "req-1 k=8 n=5", rctx, 400_000],
+                [45, base + 9_850_000, "xla_compile", "window", None, 3_000_000],
+                [46, base + 9_900_000, "s_finish", "req-1 stop", rctx, 0],
+                [47, base + 9_950_000, "s_reject", "req-2 length", None, None],
+            ],
         },
+        "dropped_events": {"llm": 23},
     }
     return [a, b, None]
 
@@ -251,8 +383,8 @@ def self_check() -> list[str]:
     merged = merge_trace_snapshots(_sample_snapshots())
     errors = validate_chrome_trace(to_chrome_trace(merged))
     tracks = {(p["machine"], p["process"]) for p in merged["processes"]}
-    if len(tracks) != 3:
-        errors.append(f"expected 3 process tracks, got {sorted(tracks)}")
+    if len(tracks) != 4:
+        errors.append(f"expected 4 process tracks, got {sorted(tracks)}")
     # Clock alignment: B's recv must land after A's send on the merged
     # axis even though B's raw wall clock ran ahead.
     walls = {
@@ -272,4 +404,31 @@ def self_check() -> list[str]:
     }
     if len(ids) != 1:
         errors.append(f"expected one linked trace id, got {ids}")
+    # Engine track: the request-lifecycle chain must export in order on
+    # tid 1 with its thread_name meta, linked by the same trace id as
+    # the message chain that carried the request in.
+    engine_spans = [
+        ev for ev in trace["traceEvents"]
+        if ev["ph"] == "X" and ev.get("cat") == "serving"
+    ]
+    chain = [ev["name"].split(" ", 1)[0] for ev in engine_spans]
+    want = ["queued", "admitted", "prefill_chunk", "decode_window", "finish"]
+    if chain != want:
+        errors.append(f"lifecycle chain broken: {chain}")
+    if any(ev.get("args", {}).get("trace_id") not in ids for ev in engine_spans):
+        errors.append("serving spans not linked to the message trace id")
+    metas = {
+        (ev["pid"], ev["tid"]): ev["args"]["name"]
+        for ev in trace["traceEvents"]
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    }
+    if "engine" not in metas.values():
+        errors.append("engine thread_name meta missing")
+    truncated = [
+        ev["name"] for ev in trace["traceEvents"]
+        if ev["ph"] == "i" and ev["name"].startswith("trace truncated")
+    ]
+    # One from the ring-shipped event, one from the daemon-cap count.
+    if len(truncated) != 2:
+        errors.append(f"expected 2 trace-truncated instants, got {truncated}")
     return errors

@@ -67,6 +67,10 @@ def test_render_serving_table_with_rates():
                     "slots_total": 16,
                     "free_pages": 120,
                     "total_pages": 128,
+                    "used_pages": 8,
+                    "peak_used_pages": 24,
+                    "largest_contig_free": 96,
+                    "compiles": 6,
                     "backlog_depth": 2,
                     "host_dispatches": 30,
                     "host_fetches": 28,
@@ -78,6 +82,9 @@ def test_render_serving_table_with_rates():
                     "dispatch_gap_us": {
                         "count": 30, "p50_us": 512.0, "p99_us": 4096.0,
                     },
+                    "fetch_us": {
+                        "count": 28, "p50_us": 256.0, "p99_us": 1024.0,
+                    },
                 }
             }
         }
@@ -85,18 +92,68 @@ def test_render_serving_table_with_rates():
     out = render_metrics("u", snap(150), prev=snap(50), interval=2.0)
     assert "SERVING" in out and "llm (paged)" in out
     assert "3/16" in out  # slots
-    assert "120/128" in out  # pages
+    assert "8/128" in out  # pages: OCCUPANCY (used/total)
     assert "50.0" in out  # (150 - 50) / 2.0 tok/s
     assert "2.5ms" in out  # ttft p50
     assert "TOK/DISP" in out and "5.0" in out  # tokens per dispatch
     assert "GAP P50" in out and "512µs" in out  # dispatch-gap histogram
+    assert "FETCH P50" in out and "256µs" in out  # fetch split from gap
+    assert "COMPILES" in out and "6" in out  # xla compile audit counter
+    # Page sparkline with peak + fragmentation gauges.
+    assert "pages llm [" in out and "peak 24" in out and "contig 96" in out
     one_shot = render_metrics("u", snap(150))
     assert "llm (paged)" in one_shot  # renders without watch deltas too
     # Snapshots predating the window metrics render with dashes.
     bare = snap(10)
-    for key in ("tokens_per_dispatch", "dispatch_gap_us"):
+    for key in ("tokens_per_dispatch", "dispatch_gap_us", "fetch_us",
+                "used_pages", "peak_used_pages", "largest_contig_free",
+                "compiles"):
         del bare["serving"]["llm"][key]
     assert "llm (paged)" in render_metrics("u", bare)
+
+
+def test_render_watch_rate_clamps_and_reset():
+    """Satellite fix: the watch-mode rate divides by MEASURED wall time
+    between snapshots from different daemons — a ~0 interval must clamp
+    to 1 ms (no exploded rate, no ZeroDivisionError), and a counter
+    that went BACKWARD (node restart) renders '-' instead of a negative
+    rate."""
+    from dora_tpu.cli.metrics_view import render_metrics
+
+    snap = {
+        "links": {"a/out": {"msgs": 100, "bytes": 1000}},
+        "serving": {
+            "llm": {"engine": "paged", "decode_tokens": 10, "requests": 1},
+        },
+    }
+    prev = {
+        "links": {"a/out": {"msgs": 50, "bytes": 500}},
+        "serving": {
+            "llm": {"engine": "paged", "decode_tokens": 400, "requests": 9},
+        },
+    }
+    # interval 0 (same-instant snapshots): clamps to 1 ms -> 50 msgs /
+    # 0.001 s = 50000/s, finite and rendered.
+    out = render_metrics("u", snap, prev=prev, interval=0.0)
+    assert "50000.0" in out
+    # decode_tokens went 400 -> 10: reset renders '-', never "-195000.0".
+    serving_line = next(ln for ln in out.splitlines() if "llm (" in ln)
+    assert "-195" not in serving_line
+    # Sparkline history renders one cell per snapshot.
+    hist_snap = {
+        "serving": {
+            "llm": {
+                "engine": "paged", "total_pages": 100, "used_pages": 100,
+            }
+        }
+    }
+    older = {
+        "serving": {
+            "llm": {"engine": "paged", "total_pages": 100, "used_pages": 0}
+        }
+    }
+    out = render_metrics("u", hist_snap, history=[older, hist_snap])
+    assert "pages llm [ ██]" in out
 
 
 REPORTER = textwrap.dedent(
