@@ -441,6 +441,30 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_migrate(args) -> int:
+    """Drain a serving node's live KV streams into a handoff directory
+    another engine (running with ``DORA_MIGRATE_DIR`` pointed at it)
+    admits and continues — each stream under its original trace id."""
+    handoff_dir = str(Path(args.handoff_dir).resolve())
+    with _control(args) as c:
+        reply = c.request(
+            cm.MigrateNode(
+                dataflow_uuid=args.uuid,
+                node_id=args.node,
+                handoff_dir=handoff_dir,
+                name=args.name,
+            )
+        )
+        if isinstance(reply, cm.Error):
+            print(reply.message, file=sys.stderr)
+            return 1
+        print(
+            f"migrating {reply.node_id} of {reply.uuid}: "
+            f"streams drain into {reply.handoff_dir}"
+        )
+    return 0
+
+
 def cmd_logs(args) -> int:
     with _control(args) as c:
         reply = c.request(cm.Logs(uuid=args.uuid, name=args.name, node=args.node))
@@ -600,6 +624,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     coordinator_addr(p)
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "migrate",
+        help="drain a serving node's live streams into a handoff dir",
+    )
+    p.add_argument("node", help="node id of the serving engine to drain")
+    p.add_argument(
+        "--handoff-dir", required=True,
+        help="directory the target engine polls (its DORA_MIGRATE_DIR)",
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_migrate)
 
     p = sub.add_parser("logs", help="print a node's logs")
     p.add_argument("node")

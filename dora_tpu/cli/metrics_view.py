@@ -198,4 +198,37 @@ def render_metrics(
                 f"peak {s.get('peak_used_pages', 0)} "
                 f"contig {s.get('largest_contig_free', 0)}"
             ]
+
+    # Elastic-recovery plane: daemon-side respawn/replay counters merge
+    # with serving-side checkpoint/migration counters by node id. The
+    # table only appears once something recovered — steady state stays
+    # clean.
+    recovery = snap.get("recovery") or {}
+    respawns = recovery.get("respawns") or {}
+    replayed = recovery.get("replayed_inputs") or {}
+    rec_nodes = set(respawns) | set(replayed)
+    for nid, s in serving.items():
+        if (s.get("checkpoints") or s.get("restored_streams")
+                or s.get("migrated_out") or s.get("migrated_in")):
+            rec_nodes.add(nid)
+    if rec_nodes:
+        rec_rows = []
+        for nid in sorted(rec_nodes):
+            s = serving.get(nid, {})
+            age = s.get("checkpoint_age_s")
+            rec_rows.append([
+                nid,
+                str(respawns.get(nid, 0)),
+                str(replayed.get(nid, 0)),
+                str(s.get("checkpoints", 0)),
+                f"{age:.1f}s" if age is not None else "-",
+                str(s.get("restored_streams", 0)),
+                str(s.get("migrated_out", 0)),
+                str(s.get("migrated_in", 0)),
+            ])
+        lines += [""] + _table(
+            ["RECOVERY", "RESPAWNS", "REPLAYED", "CKPTS", "CKPT AGE",
+             "RESTORED", "MIG OUT", "MIG IN"],
+            rec_rows,
+        )
     return "\n".join(lines).rstrip() + "\n"

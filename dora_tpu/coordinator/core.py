@@ -44,6 +44,9 @@ class DaemonHandle:
     listen_addr: str  # inter-daemon data address "host:port"
     last_heartbeat: float = field(default_factory=time.monotonic)
     connected: bool = True
+    #: the register connection's StreamWriter (tests force-drop it to
+    #: exercise the daemon's reconnect path)
+    writer: Any = None
 
 
 @dataclass
@@ -124,6 +127,7 @@ class Coordinator:
 
     async def _handle_daemon(self, reader, writer) -> None:
         machine_id: str | None = None
+        handle: DaemonHandle | None = None
         try:
             frame = await recv_frame_async(reader)
             msg = decode_timestamped(frame, self.clock).inner
@@ -138,7 +142,21 @@ class Coordinator:
                     f"(coordinator speaks {PROTOCOL_VERSION})"
                 )
             elif msg.machine_id in self.daemons and self.daemons[msg.machine_id].connected:
-                error = f"machine id {msg.machine_id!r} already registered"
+                # Re-register replaces the existing (likely half-open)
+                # connection: a daemon only reconnects after losing its
+                # side, and the heartbeat watchdog may not have noticed
+                # yet. Last registration wins.
+                logger.warning(
+                    "machine %r re-registered; replacing stale connection",
+                    msg.machine_id,
+                )
+                stale = self.daemons[msg.machine_id]
+                stale.connected = False
+                if stale.writer is not None:
+                    try:
+                        stale.writer.close()
+                    except Exception:
+                        pass
             await self._send(writer, cm.RegisterDaemonReply(error=error))
             if error:
                 return
@@ -148,6 +166,7 @@ class Coordinator:
                 machine_id=machine_id,
                 outbox=asyncio.Queue(),
                 listen_addr=f"{peer_host}:{msg.listen_port}",
+                writer=writer,
             )
             self.daemons[machine_id] = handle
             logger.info("daemon %r registered (data %s)", machine_id, handle.listen_addr)
@@ -164,8 +183,11 @@ class Coordinator:
         except Exception:
             logger.exception("daemon connection failed")
         finally:
-            if machine_id is not None and self.daemons.get(machine_id) is not None:
-                self.daemons[machine_id].connected = False
+            # Identity check: if the daemon already re-registered, the
+            # machine id maps to a FRESH handle — marking disconnected by
+            # id alone would clobber the live re-registration.
+            if handle is not None and self.daemons.get(machine_id) is handle:
+                handle.connected = False
             try:
                 writer.close()
             except Exception:
@@ -570,6 +592,32 @@ class Coordinator:
                 ),
             )
             return cm.DataflowReloaded(uuid=df.uuid)
+        if isinstance(request, cm.MigrateNode):
+            target = request.dataflow_uuid or request.name
+            if target is not None:
+                uuid = self.resolve_name(target)
+            else:
+                uuid = self._query_target(None, None)
+                if isinstance(uuid, cm.Error):
+                    return uuid
+            df = self.running.get(uuid)
+            if df is None:
+                return cm.Error(message=f"dataflow {uuid!r} is not running")
+            node = df.descriptor.node(request.node_id)
+            machine = node.deploy.machine or next(iter(df.machines))
+            self._daemon_send(
+                machine,
+                cm.MigrateDataflowNode(
+                    dataflow_id=df.uuid,
+                    node_id=request.node_id,
+                    handoff_dir=request.handoff_dir,
+                ),
+            )
+            return cm.NodeMigrated(
+                uuid=df.uuid,
+                node_id=request.node_id,
+                handoff_dir=request.handoff_dir,
+            )
         if isinstance(request, cm.Logs):
             uuid = self.resolve_name(request.uuid or request.name)
             logs = await self.request_logs(uuid, request.node)

@@ -158,6 +158,47 @@ class Deploy:
 
 
 @dataclass(frozen=True)
+class RestartPolicy:
+    """Per-node elastic-recovery policy (``restart:`` in the descriptor).
+
+    A node that fails post-barrier (nonzero exit, signal, spawn error)
+    is respawned by its daemon up to ``max_attempts`` times with
+    exponential backoff (``backoff_base_s * 2**attempt`` capped at
+    ``backoff_max_s``, plus jitter), and its un-acked in-flight inputs
+    are replayed from the daemon-side replay buffer. Grace kills,
+    cascading failures, and pre-barrier failures never respawn.
+    """
+
+    max_attempts: int = 0
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 15.0
+
+    @classmethod
+    def parse(cls, value: Any) -> "RestartPolicy | None":
+        if value is None or value is False:
+            return None
+        if value is True:
+            return cls(max_attempts=1)
+        if isinstance(value, int):
+            return cls(max_attempts=value) if value > 0 else None
+        if not isinstance(value, Mapping):
+            raise ValueError(
+                f"'restart' must be a mapping, int, or bool, got {type(value).__name__}"
+            )
+        unknown = set(value) - {"max_attempts", "backoff_base_s", "backoff_max_s"}
+        if unknown:
+            raise ValueError(f"unknown restart keys: {sorted(unknown)}")
+        policy = cls(
+            max_attempts=int(value.get("max_attempts", 1)),
+            backoff_base_s=float(value.get("backoff_base_s", 0.5)),
+            backoff_max_s=float(value.get("backoff_max_s", 15.0)),
+        )
+        if policy.max_attempts < 0 or policy.backoff_base_s < 0:
+            raise ValueError("restart: max_attempts/backoff_base_s must be >= 0")
+        return policy if policy.max_attempts > 0 else None
+
+
+@dataclass(frozen=True)
 class CustomNode:
     """A node that is its own executable (or a dynamic/externally-attached
     process)."""
@@ -189,6 +230,7 @@ class ResolvedNode:
     env: dict[str, Any]
     deploy: Deploy
     kind: CustomNode | RuntimeNode
+    restart: RestartPolicy | None = None
 
     @property
     def inputs(self) -> dict[DataId, Input]:
@@ -378,6 +420,7 @@ class Descriptor:
             env=env,
             deploy=deploy,
             kind=kind,
+            restart=RestartPolicy.parse(value.get("restart")),
         )
 
     # -- queries ------------------------------------------------------------

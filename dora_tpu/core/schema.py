@@ -123,6 +123,27 @@ _CUSTOM = {
     "additionalProperties": False,
 }
 
+_RESTART = {
+    "description": (
+        "Elastic-recovery policy: respawn this node on post-barrier "
+        "failure. true = one attempt; an integer = that many attempts; "
+        "a mapping tunes the exponential backoff."
+    ),
+    "oneOf": [
+        {"type": "boolean"},
+        {"type": "integer", "minimum": 0},
+        {
+            "type": "object",
+            "properties": {
+                "max_attempts": {"type": "integer", "minimum": 0},
+                "backoff_base_s": {"type": "number", "minimum": 0},
+                "backoff_max_s": {"type": "number", "minimum": 0},
+            },
+            "additionalProperties": False,
+        },
+    ],
+}
+
 _NODE = {
     "type": "object",
     "properties": {
@@ -132,6 +153,7 @@ _NODE = {
         "env": {"$ref": "#/definitions/env"},
         "deploy": {"$ref": "#/definitions/deploy"},
         "_unstable_deploy": {"$ref": "#/definitions/deploy"},
+        "restart": {"$ref": "#/definitions/restart"},
         # node kinds (exactly one)
         "path": {
             "type": "string",
@@ -226,6 +248,7 @@ def descriptor_schema() -> dict[str, Any]:
             "outputs": _OUTPUTS,
             "env": _ENV,
             "deploy": _DEPLOY,
+            "restart": _RESTART,
             "communication": _COMMUNICATION,
         },
     }

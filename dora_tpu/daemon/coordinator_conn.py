@@ -1,16 +1,24 @@
 """Attached-mode daemon: connection to the coordinator.
 
-Reference parity: binaries/daemon/src/coordinator.rs (register with 1 s
+Reference parity: binaries/daemon/src/coordinator.rs (register with
 retry, event/reply pump) and the coordinator-event handling arm of the
 daemon main loop (daemon/src/lib.rs:364-407). Heartbeat constants match
 the reference: daemon→coordinator every 5 s, bail after 20 s of silence
 (daemon/src/lib.rs:262-268,308-324).
+
+A dropped coordinator connection is NOT fatal: the daemon keeps its
+dataflows running and re-registers with exponential backoff + jitter.
+The reconnect budget stays under the coordinator's 30 s heartbeat-drop
+window so the machine slot is still listed when the daemon comes back.
+The outbox outlives individual connections — notifications queued while
+disconnected (AllNodesFinished, logs, …) flush after re-register.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import TYPE_CHECKING
 
@@ -20,6 +28,7 @@ from dora_tpu.daemon import inter_daemon
 from dora_tpu.daemon.spawn import log_file_path
 from dora_tpu.message import coordinator as cm
 from dora_tpu.message.serde import decode_timestamped, encode_timestamped
+from dora_tpu.telemetry import FLIGHT
 from dora_tpu.transport.framing import (
     ConnectionClosed,
     recv_frame_async,
@@ -33,7 +42,15 @@ logger = logging.getLogger(__name__)
 
 HEARTBEAT_INTERVAL_S = 5.0
 COORDINATOR_SILENCE_BAIL_S = 20.0
-REGISTER_RETRY_S = 1.0
+REGISTER_RETRY_S = 1.0  # kept for back-compat; backoff starts here
+
+#: Reconnect backoff: base * 2^attempt with ±25 % jitter, capped.
+RECONNECT_BACKOFF_BASE_S = 0.5
+RECONNECT_BACKOFF_MAX_S = 5.0
+#: Total budget for re-registering after a dropped connection. Must stay
+#: under the coordinator's HEARTBEAT_DROP_S (30 s) so the machine is
+#: still registered when the daemon comes back.
+RECONNECT_WINDOW_S = 25.0
 
 
 async def run_attached(
@@ -42,7 +59,11 @@ async def run_attached(
     machine_id: str,
     register_timeout_s: float = 30.0,
 ) -> None:
-    """Register with the coordinator and serve its events until destroyed."""
+    """Register with the coordinator and serve its events until destroyed.
+
+    Connection losses inside that lifetime trigger re-register with
+    backoff (see module docstring); only DestroyDaemon — or exhausting
+    the reconnect window — tears the daemon down."""
     daemon.machine_id = machine_id
     await daemon.start()
     # SIGUSR2 forensics for attached daemons too (run_dataflow_async has
@@ -55,48 +76,10 @@ async def run_attached(
     inter_client = inter_daemon.InterDaemonClient(daemon.clock)
 
     host, _, port = coordinator_addr.rpartition(":")
-    deadline = time.monotonic() + register_timeout_s
-    reader = writer = None
-    while True:
-        try:
-            reader, writer = await asyncio.open_connection(host, int(port))
-            break
-        except ConnectionError:
-            if time.monotonic() > deadline:
-                raise
-            await asyncio.sleep(REGISTER_RETRY_S)
 
-    await send_frame_async(
-        writer,
-        encode_timestamped(
-            cm.RegisterDaemon(
-                machine_id=machine_id,
-                protocol_version=PROTOCOL_VERSION,
-                listen_port=inter_port,
-            ),
-            daemon.clock,
-        ),
-    )
-    reply = decode_timestamped(await recv_frame_async(reader), daemon.clock).inner
-    if not isinstance(reply, cm.RegisterDaemonReply) or reply.error:
-        raise RuntimeError(f"daemon register failed: {getattr(reply, 'error', reply)}")
-
+    # The outbox outlives connections: messages queued while disconnected
+    # are flushed after re-register instead of being lost.
     outbox: asyncio.Queue = asyncio.Queue()
-    last_contact = time.monotonic()
-
-    async def sender():
-        while True:
-            msg = await outbox.get()
-            await send_frame_async(writer, encode_timestamped(msg, daemon.clock))
-
-    async def heartbeat():
-        while True:
-            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
-            if time.monotonic() - last_contact > COORDINATOR_SILENCE_BAIL_S:
-                logger.error("coordinator silent for >%ss; bailing", COORDINATOR_SILENCE_BAIL_S)
-                writer.close()
-                return
-            outbox.put_nowait(cm.DaemonHeartbeat())
 
     def notify(kind: str, df, payload) -> None:
         if kind == "ready":
@@ -127,6 +110,128 @@ async def run_attached(
 
     daemon.inter_daemon_send = send_inter
 
+    first = True
+    try:
+        while True:
+            try:
+                reader, writer = await _connect_register(
+                    daemon,
+                    host,
+                    int(port),
+                    machine_id,
+                    inter_port,
+                    timeout_s=register_timeout_s if first else RECONNECT_WINDOW_S,
+                )
+            except (ConnectionError, RuntimeError):
+                if first:
+                    raise
+                logger.error(
+                    "could not re-register with coordinator within %ss; giving up",
+                    RECONNECT_WINDOW_S,
+                )
+                return
+            if not first:
+                logger.info("re-registered with coordinator")
+                if FLIGHT.enabled:
+                    FLIGHT.record("daemon_reconnect", machine_id, 0)
+            first = False
+            destroyed = await _serve_connection(
+                daemon, reader, writer, outbox, machine_id
+            )
+            if destroyed:
+                return
+            logger.error("lost coordinator connection; reconnecting")
+    finally:
+        remove_task_dump(loop)
+        inter_client.close()
+        inter_server.close()
+        await daemon.close()
+
+
+async def _connect_register(
+    daemon: "Daemon",
+    host: str,
+    port: int,
+    machine_id: str,
+    inter_port: str,
+    timeout_s: float,
+):
+    """Connect + RegisterDaemon with exponential backoff + jitter until
+    ``timeout_s`` elapses. A registration *rejection* raises immediately
+    (retrying cannot change the coordinator's answer)."""
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        writer = None
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            await send_frame_async(
+                writer,
+                encode_timestamped(
+                    cm.RegisterDaemon(
+                        machine_id=machine_id,
+                        protocol_version=PROTOCOL_VERSION,
+                        listen_port=inter_port,
+                    ),
+                    daemon.clock,
+                ),
+            )
+            reply = decode_timestamped(
+                await recv_frame_async(reader), daemon.clock
+            ).inner
+            if not isinstance(reply, cm.RegisterDaemonReply) or reply.error:
+                raise RuntimeError(
+                    f"daemon register failed: {getattr(reply, 'error', reply)}"
+                )
+            return reader, writer
+        except (ConnectionError, ConnectionClosed, OSError) as e:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            if time.monotonic() > deadline:
+                raise ConnectionError(f"coordinator unreachable: {e}") from e
+            attempt += 1
+            delay = min(
+                RECONNECT_BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                RECONNECT_BACKOFF_MAX_S,
+            )
+            await asyncio.sleep(delay * (0.75 + 0.5 * random.random()))
+
+
+async def _serve_connection(
+    daemon: "Daemon", reader, writer, outbox: asyncio.Queue, machine_id: str
+) -> bool:
+    """Pump one coordinator connection. Returns True on DestroyDaemon
+    (clean teardown), False when the connection dropped (caller
+    reconnects)."""
+    last_contact = time.monotonic()
+
+    async def sender():
+        while True:
+            msg = await outbox.get()
+            try:
+                await send_frame_async(
+                    writer, encode_timestamped(msg, daemon.clock)
+                )
+            except (ConnectionError, ConnectionClosed, OSError):
+                # Keep the message: it retransmits after reconnect.
+                outbox.put_nowait(msg)
+                return
+
+    async def heartbeat():
+        while True:
+            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+            if time.monotonic() - last_contact > COORDINATOR_SILENCE_BAIL_S:
+                logger.error(
+                    "coordinator silent for >%ss; dropping connection",
+                    COORDINATOR_SILENCE_BAIL_S,
+                )
+                writer.close()
+                return
+            outbox.put_nowait(cm.DaemonHeartbeat())
+
     tasks = [asyncio.create_task(sender()), asyncio.create_task(heartbeat())]
     try:
         while True:
@@ -153,6 +258,10 @@ async def run_attached(
                 df = daemon.dataflows.get(event.dataflow_id)
                 if df is not None:
                     daemon.reload_node(df, event.node_id, event.operator_id)
+            elif isinstance(event, cm.MigrateDataflowNode):
+                df = daemon.dataflows.get(event.dataflow_id)
+                if df is not None:
+                    daemon.migrate_node(df, event.node_id, event.handoff_dir)
             elif isinstance(event, cm.LogsRequest):
                 df = daemon.dataflows.get(event.dataflow_id)
                 logs = b""
@@ -190,22 +299,18 @@ async def run_attached(
                     )
                 )
             elif isinstance(event, cm.DestroyDaemon):
-                return
+                return True
             else:
                 logger.warning("unexpected coordinator event %s", type(event).__name__)
-    except (ConnectionClosed, ConnectionError):
-        logger.error("lost coordinator connection")
+    except (ConnectionClosed, ConnectionError, OSError):
+        return False
     finally:
         for t in tasks:
             t.cancel()
-        remove_task_dump(loop)
-        inter_client.close()
-        inter_server.close()
         try:
             writer.close()
         except Exception:
             pass
-        await daemon.close()
 
 
 async def _handle_spawn(daemon: "Daemon", outbox, event: cm.SpawnDataflowNodes) -> None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -33,6 +34,7 @@ from dora_tpu.daemon.connection import (
 )
 from dora_tpu.transport.framing import ConnectionClosed
 from dora_tpu.daemon.queues import DropQueue, NodeEventQueue, QueueEntry
+from dora_tpu.daemon.replay_buffer import ReplayBuffer
 from dora_tpu.ids import DataId, InputId, NodeId, OutputId
 from dora_tpu.message import daemon_to_node as d2n
 from dora_tpu.message import node_to_daemon as n2d
@@ -176,6 +178,19 @@ class DataflowState:
     #: serving plane: node id -> latest ServingMetrics snapshot the node
     #: shipped via ReportServing (latest-wins; snapshots are cumulative)
     node_serving: dict[str, dict] = field(default_factory=dict)
+    #: elastic recovery: node id -> respawn attempts consumed so far
+    respawn_attempts: dict[str, int] = field(default_factory=dict)
+    #: nodes between death and respawn — the finish check treats them
+    #: as still running, so the dataflow cannot conclude under them
+    respawning: set[str] = field(default_factory=set)
+    #: node id -> un-acked delivered-input window, redelivered on
+    #: respawn (nodes with a ``restart`` policy only)
+    replay_buffers: dict[str, ReplayBuffer] = field(default_factory=dict)
+    #: node id -> the asyncio task consuming its event queue. Respawn
+    #: cancels the dead incarnation's task BEFORE replaying: a loop
+    #: parked in next_batch cannot see its socket die, and waking it
+    #: with the replayed entries would hand them to a dead connection.
+    event_tasks: dict[str, asyncio.Task] = field(default_factory=dict)
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -339,6 +354,10 @@ class Daemon:
             )
             df.drop_queues[nid] = DropQueue()
             df.control_done[nid] = asyncio.Event()
+            if node.restart is not None:
+                df.replay_buffers[nid] = ReplayBuffer(
+                    nid, spill_dir=working_dir / ".dora-replay" / dataflow_id
+                )
             dynamic = isinstance(node.kind, CustomNode) and node.kind.is_dynamic
             df.running_nodes[nid] = RunningNode(node_id=nid, dynamic=dynamic)
             if not dynamic:
@@ -453,6 +472,11 @@ class Daemon:
                     rnode in df.local_nodes
                     and listeners is not None
                     and str(target.input) in listeners
+                    # A restartable receiver's inputs stay daemon-routed:
+                    # crash replay needs the daemon to hold the un-acked
+                    # in-flight window (ReplayBuffer), and p2p events
+                    # bypass it entirely.
+                    and df.descriptor.node(rnode).restart is None
                 ):
                     df.p2p_edges.add(
                         (sender, str(oid.output), rnode, str(target.input))
@@ -872,25 +896,26 @@ class Daemon:
         else:
             status = NodeExitStatus(success=False, code=returncode)
 
-        if status.success:
-            result = NodeResult(error=None)
-        else:
-            if nid in df.grace_kills:
-                cause = NodeErrorCause(kind="grace_duration")
-            elif df.failed_nodes:
-                cause = NodeErrorCause(
-                    kind="cascading", caused_by_node=df.failed_nodes[0]
-                )
-            elif df.barrier_error is not None and nid != df.barrier_failed_node:
-                cause = NodeErrorCause(
-                    kind="cascading", caused_by_node=df.barrier_failed_node
-                )
-            else:
-                stderr = "\n".join(df.stderr_rings.get(nid, [])) or None
-                cause = NodeErrorCause(kind="other", stderr=stderr)
-            result = NodeResult(error=NodeError(exit_status=status, cause=cause))
-            df.failed_nodes.append(nid)
-        df.node_results[nid] = result
+        # Elastic recovery: a failed node with remaining restart budget
+        # respawns instead of failing the dataflow. Decided BEFORE any
+        # failure bookkeeping — recording the failure would cascade the
+        # rest of the dataflow, and closing the queue would propagate
+        # AllInputsClosed downstream and finish the run under us.
+        if not status.success and self._should_respawn(df, nid):
+            attempt = df.respawn_attempts.get(nid, 0) + 1
+            df.respawn_attempts[nid] = attempt
+            df.respawning.add(nid)
+            df.metrics.count_respawn(nid)
+            if FLIGHT.enabled:
+                FLIGHT.record("node_respawn", nid, attempt)
+            logger.warning(
+                "node %s/%s failed (%s); respawn attempt %d",
+                df.id, nid, error or f"code {returncode}", attempt,
+            )
+            asyncio.create_task(self._respawn_node(df, nid, attempt, status))
+            return
+
+        self._record_exit_result(df, nid, status)
 
         # Barrier poison: node died before subscribing. In multi-machine
         # mode the coordinator must learn about it so the other machines'
@@ -918,11 +943,135 @@ class Daemon:
         drop_queue = df.drop_queues.get(nid)
         if drop_queue is not None:
             drop_queue.close()
+        buffer = df.replay_buffers.get(nid)
+        if buffer is not None:
+            buffer.close()
 
         # Output closing + finish-check are deferred until the node's control
         # connection has drained: SendMessages still in the socket buffer at
         # exit time must route before the outputs close.
         asyncio.create_task(self._finalize_node_exit(df, nid))
+
+    def _record_exit_result(self, df: DataflowState, nid: str,
+                            status: NodeExitStatus) -> None:
+        """Classify an exit (grace_duration / cascading / other) and
+        record the NodeResult + failure bookkeeping."""
+        if status.success:
+            result = NodeResult(error=None)
+        else:
+            if nid in df.grace_kills:
+                cause = NodeErrorCause(kind="grace_duration")
+            elif df.failed_nodes:
+                cause = NodeErrorCause(
+                    kind="cascading", caused_by_node=df.failed_nodes[0]
+                )
+            elif df.barrier_error is not None and nid != df.barrier_failed_node:
+                cause = NodeErrorCause(
+                    kind="cascading", caused_by_node=df.barrier_failed_node
+                )
+            else:
+                stderr = "\n".join(df.stderr_rings.get(nid, [])) or None
+                cause = NodeErrorCause(kind="other", stderr=stderr)
+            result = NodeResult(error=NodeError(exit_status=status, cause=cause))
+            df.failed_nodes.append(nid)
+        df.node_results[nid] = result
+
+    def _should_respawn(self, df: DataflowState, nid: str) -> bool:
+        """A failed exit respawns only while the dataflow is otherwise
+        healthy: barrier released cleanly, no stop in flight, the node was
+        not grace-killed, no other node has already failed (that failure
+        is about to end the run anyway), and restart budget remains."""
+        node = df.descriptor.node(nid)
+        if node.restart is None:
+            return False
+        if df.stop_sent or nid in df.grace_kills or df.done.done():
+            return False
+        if not df.started.is_set() or df.barrier_error is not None:
+            return False
+        if df.failed_nodes:
+            return False
+        return df.respawn_attempts.get(nid, 0) < node.restart.max_attempts
+
+    async def _respawn_node(
+        self,
+        df: DataflowState,
+        nid: str,
+        attempt: int,
+        status: NodeExitStatus,
+    ) -> None:
+        """Backoff, replay the un-acked input window, spawn a fresh
+        incarnation. If the dataflow stopped during the backoff, fall back
+        to recording the original failure like a normal exit."""
+        node = df.descriptor.node(nid)
+        policy = node.restart
+        delay = min(
+            policy.backoff_base_s * (2 ** (attempt - 1)), policy.backoff_max_s
+        )
+        # Jitter decorrelates simultaneous respawns across a machine.
+        await asyncio.sleep(delay * (0.75 + 0.5 * random.random()))
+
+        if df.stop_sent or df.done.done():
+            df.respawning.discard(nid)
+            self._record_exit_result(df, nid, status)
+            queue = df.queues.get(nid)
+            if queue is not None:
+                queue.release_all_tokens()
+                queue.close()
+            for token in df.delivered_tokens.pop(nid, set()):
+                self._unref_token(df, token)
+            dq = df.drop_queues.get(nid)
+            if dq is not None:
+                dq.close()
+            buffer = df.replay_buffers.get(nid)
+            if buffer is not None:
+                buffer.close()
+            await self._finalize_node_exit(df, nid)
+            return
+
+        # Fresh control-drain latch for the new incarnation (the old one
+        # is set — its connection is gone).
+        df.control_done[nid] = asyncio.Event()
+
+        # The dead incarnation's events loop can still be parked in
+        # queue.next_batch (a coroutine awaiting the queue never sees its
+        # socket drop). Left alive, the replay below would WAKE it: it
+        # would consume the requeued entries and send them to the dead
+        # connection. Cancel it before touching the queue — next_batch
+        # is cancellation-safe (a cancel while parked consumes nothing).
+        stale = df.event_tasks.pop(nid, None)
+        if stale is not None and not stale.done():
+            stale.cancel()
+            try:
+                await stale
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        # Replay: un-acked in-flight inputs go back to the FRONT of the
+        # queue, ahead of anything routed while the node was down.
+        buffer = df.replay_buffers.get(nid)
+        if buffer is not None and len(buffer):
+            entries = buffer.drain()
+            queue = df.queues.get(nid)
+            if queue is not None:
+                queue.requeue_front(entries)
+            df.metrics.count_replayed(nid, len(entries))
+            if FLIGHT.enabled:
+                FLIGHT.record("replay_inputs", nid, len(entries))
+            logger.info(
+                "node %s/%s: replaying %d un-acked input(s) on respawn",
+                df.id, nid, len(entries),
+            )
+
+        was_dynamic = df.running_nodes[nid].dynamic
+        df.running_nodes[nid] = RunningNode(node_id=nid, dynamic=was_dynamic)
+        df.respawning.discard(nid)
+        node_config = self._make_node_config(df, nid)
+        try:
+            process = await spawn_mod.spawn_node(self, df, node, node_config)
+        except RuntimeError as e:
+            self.handle_node_exit(df, nid, None, error=str(e))
+            return
+        df.running_nodes[nid].process = process
 
     async def _finalize_node_exit(self, df: DataflowState, nid: str) -> None:
         done = df.control_done.get(nid)
@@ -939,7 +1088,7 @@ class Daemon:
         pending = [
             r
             for r in df.running_nodes.values()
-            if not r.finished and not r.dynamic
+            if (not r.finished or r.node_id in df.respawning) and not r.dynamic
         ]
         if pending:
             return
@@ -951,6 +1100,8 @@ class Daemon:
             queue.close()
         for dq in df.drop_queues.values():
             dq.close()
+        for buffer in df.replay_buffers.values():
+            buffer.close()
         for region in df.mapped_regions.values():
             try:
                 region.close(unlink=False, force=True)
@@ -1038,6 +1189,18 @@ class Daemon:
             queue.push(
                 Timestamped(
                     inner=d2n.Reload(operator_id=operator_id),
+                    timestamp=self.clock.new_timestamp(),
+                )
+            )
+
+    def migrate_node(self, df: DataflowState, node_id: str, handoff_dir: str) -> None:
+        """Ask a serving node to drain its live streams into
+        ``handoff_dir`` at the next window boundary (cm.MigrateNode)."""
+        queue = df.queues.get(node_id)
+        if queue is not None:
+            queue.push(
+                Timestamped(
+                    inner=d2n.Migrate(handoff_dir=handoff_dir),
                     timestamp=self.clock.new_timestamp(),
                 )
             )
@@ -1196,6 +1359,9 @@ class Daemon:
 
         queue = df.queues[node_id]
         delivered = df.delivered_tokens.setdefault(node_id, set())
+        replay = df.replay_buffers.get(node_id)
+        df.event_tasks[node_id] = asyncio.current_task()
+        first_poll = True
         while True:
             frame = await conn.recv()
             if frame is None:
@@ -1203,7 +1369,17 @@ class Daemon:
             msg = decode_timestamped(frame, self.clock).inner
             if isinstance(msg, n2d.NextEvent):
                 self.ack_tokens(df, node_id, msg.drop_tokens)
+                if replay is not None and not first_poll:
+                    # The poll is the ack seam: batch k+1 is requested
+                    # only after batch k was consumed — but only on THIS
+                    # connection. A fresh incarnation's first poll has
+                    # consumed nothing and must not ack the window the
+                    # dead incarnation left behind.
+                    replay.ack()
+                first_poll = False
                 batch = await queue.next_batch()
+                if replay is not None:
+                    replay.remember(batch)
                 wires = []
                 deliver_ns = time.time_ns()
                 for entry in batch:

@@ -105,6 +105,25 @@ class NodeEventQueue:
                     self.metrics.count_drop(self.node_id, input_id)
                 return
 
+    def requeue_front(self, entries: list[QueueEntry]) -> None:
+        """Put already-delivered entries back at the FRONT of the queue,
+        in their original order — the replay path for a respawned node's
+        un-acked in-flight inputs. Skips the per-input bound on purpose:
+        these entries were inside the bound when first delivered, and
+        dropping them here would turn a crash into silent input loss.
+        A ``closed`` queue still accepts the replay: closed means the
+        end-of-stream marker is queued, and pending entries drain before
+        polls report end of stream — upstream finishing while the node
+        was down must not eat the replay window."""
+        for entry in reversed(entries):
+            self.entries.appendleft(entry)
+            if entry.input_id is not None:
+                self.input_counts[entry.input_id] = (
+                    self.input_counts.get(entry.input_id, 0) + 1
+                )
+        if entries:
+            self._wake()
+
     def close(self) -> None:
         """Mark the stream closed: pending entries still drain, then polls
         return empty (= end of stream)."""

@@ -107,6 +107,10 @@ async def spawn_node(
     cmd = resolve_command(node, working_dir)
 
     env = dict(os.environ)
+    # Chaos marker BEFORE node.env: fault-injection tooling
+    # (dora_tpu.tools.chaos) finds victim pids by scanning /proc/*/environ
+    # for this id; a descriptor env entry may override it.
+    env["DORA_CHAOS_ID"] = f"{df.id}:{node.id}"
     env.update({str(k): str(v) for k, v in node.env.items()})
     env[NODE_CONFIG_ENV] = encode_node_config(node_config)
     # Nodes importing dora_tpu from a source checkout need the repo root.

@@ -91,6 +91,21 @@ class QueryTrace:
 
 
 @message
+class MigrateNode:
+    """Drain a serving node's live KV streams at a window boundary and
+    re-admit them on another engine: the node quiesces, serializes its
+    active streams (tokens, positions, trace contexts, KV pages) into
+    ``handoff_dir``, and a peer engine watching that directory
+    (``DORA_MIGRATE_DIR``) re-admits them — clients see at most one
+    decode window of added latency."""
+
+    dataflow_uuid: str | None
+    node_id: str
+    handoff_dir: str
+    name: str | None = None
+
+
+@message
 class LogSubscribe:
     """Turn this control connection into a live log stream for a dataflow."""
 
@@ -126,6 +141,13 @@ class DataflowStarted:
 @message
 class DataflowReloaded:
     uuid: str
+
+
+@message
+class NodeMigrated:
+    uuid: str
+    node_id: str
+    handoff_dir: str
 
 
 @message
@@ -224,6 +246,13 @@ class ReloadDataflow:
     dataflow_id: str
     node_id: str
     operator_id: str | None = None
+
+
+@message
+class MigrateDataflowNode:
+    dataflow_id: str
+    node_id: str
+    handoff_dir: str
 
 
 @message

@@ -94,6 +94,15 @@ class Reload:
 
 
 @message
+class Migrate:
+    """Drain live serving streams into ``handoff_dir`` for re-admission
+    on a peer engine (coordinator MigrateNode flow). Non-serving nodes
+    ignore it."""
+
+    handoff_dir: str
+
+
+@message
 class Input:
     id: str  # input DataId (namespaced "<op>/<input>" inside runtime nodes)
     metadata: Metadata
@@ -110,7 +119,7 @@ class AllInputsClosed:
     pass
 
 
-NodeEvent = Stop | Reload | Input | InputClosed | AllInputsClosed
+NodeEvent = Stop | Reload | Migrate | Input | InputClosed | AllInputsClosed
 
 
 # ---------------------------------------------------------------------------

@@ -87,6 +87,8 @@ class DataflowMetrics:
         "latency",
         "fastroute_hits",
         "fastroute_fallbacks",
+        "respawns",
+        "replayed_inputs",
     )
 
     def __init__(self):
@@ -98,6 +100,10 @@ class DataflowMetrics:
         self.latency: dict[tuple[str, str], Histogram] = {}
         self.fastroute_hits = 0
         self.fastroute_fallbacks = 0
+        #: node -> times the daemon respawned it (restart policy)
+        self.respawns: dict[str, int] = {}
+        #: node -> un-acked inputs requeued to it across respawns
+        self.replayed_inputs: dict[str, int] = {}
 
     # -- hot-path feeders ---------------------------------------------------
 
@@ -118,12 +124,19 @@ class DataflowMetrics:
             hist = self.latency[(node, input_id)] = Histogram()
         hist.observe(us)
 
+    def count_respawn(self, node: str) -> None:
+        self.respawns[node] = self.respawns.get(node, 0) + 1
+
+    def count_replayed(self, node: str, n: int) -> None:
+        if n > 0:
+            self.replayed_inputs[node] = self.replayed_inputs.get(node, 0) + n
+
     # -- export -------------------------------------------------------------
 
     def snapshot(self, queue_depths: dict[str, int] | None = None) -> dict:
         hits, falls = self.fastroute_hits, self.fastroute_fallbacks
         routed = hits + falls
-        return {
+        out = {
             "links": {
                 f"{s}/{o}": {"msgs": v[0], "bytes": v[1]}
                 for (s, o), v in self.links.items()
@@ -139,6 +152,12 @@ class DataflowMetrics:
                 f"{n}/{i}": h.snapshot() for (n, i), h in self.latency.items()
             },
         }
+        if self.respawns or self.replayed_inputs:
+            out["recovery"] = {
+                "respawns": dict(self.respawns),
+                "replayed_inputs": dict(self.replayed_inputs),
+            }
+        return out
 
 
 class ServingMetrics:
@@ -161,6 +180,8 @@ class ServingMetrics:
         "free_pages", "total_pages", "used_pages", "peak_used_pages",
         "largest_contig_free", "backlog_depth", "host_dispatches",
         "host_fetches", "compiles", "engine",
+        "checkpoints", "last_checkpoint_unix", "restored_streams",
+        "migrated_out", "migrated_in",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -207,8 +228,21 @@ class ServingMetrics:
         #: recompile regression, now visible outside pytest
         self.compiles = 0
         self.engine = engine
+        #: serving-state checkpoints written (DORA_CHECKPOINT_EVERY /
+        #: SIGTERM), and the wall time of the last one — snapshot()
+        #: derives checkpoint_age_s from it so the staleness of the
+        #: recovery point is visible in `dora-tpu metrics`
+        self.checkpoints = 0
+        self.last_checkpoint_unix = 0.0
+        #: streams resumed mid-generation from a checkpoint on respawn
+        self.restored_streams = 0
+        #: live streams drained to / admitted from a migration handoff
+        self.migrated_out = 0
+        self.migrated_in = 0
 
     def snapshot(self) -> dict:
+        import time
+
         return {
             "engine": self.engine,
             "requests": self.requests,
@@ -238,6 +272,15 @@ class ServingMetrics:
             "dispatch_gap_us": self.dispatch_gap.snapshot(),
             "fetch_us": self.fetch_latency.snapshot(),
             "backlog_wait_us": self.backlog_wait.snapshot(),
+            "checkpoints": self.checkpoints,
+            "checkpoint_age_s": (
+                round(time.time() - self.last_checkpoint_unix, 3)
+                if self.last_checkpoint_unix
+                else None
+            ),
+            "restored_streams": self.restored_streams,
+            "migrated_out": self.migrated_out,
+            "migrated_in": self.migrated_in,
         }
 
 
@@ -254,11 +297,18 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
     lat_counts: dict[str, list[int]] = {}
     lat_sum: dict[str, float] = {}
     serving: dict[str, dict] = {}
+    respawns: dict[str, int] = {}
+    replayed: dict[str, int] = {}
     for snap in snapshots:
         if not snap:
             continue
         # Each serving node lives on exactly one machine: union.
         serving.update(snap.get("serving", {}))
+        recovery = snap.get("recovery") or {}
+        for key, c in recovery.get("respawns", {}).items():
+            respawns[key] = respawns.get(key, 0) + c
+        for key, c in recovery.get("replayed_inputs", {}).items():
+            replayed[key] = replayed.get(key, 0) + c
         for key, v in snap.get("links", {}).items():
             entry = links.setdefault(key, {"msgs": 0, "bytes": 0})
             entry["msgs"] += v.get("msgs", 0)
@@ -298,4 +348,9 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
     }
     if serving:
         out["serving"] = serving
+    if respawns or replayed:
+        out["recovery"] = {
+            "respawns": respawns,
+            "replayed_inputs": replayed,
+        }
     return out

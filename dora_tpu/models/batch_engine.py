@@ -306,6 +306,19 @@ class PageAllocator:
             self.peak_in_use = self.in_use
         return pages
 
+    def take(self, pages: list[int]) -> bool:
+        """Claim SPECIFIC page ids — checkpoint restore, where saved
+        block tables reference physical ids. All-or-nothing like
+        :meth:`alloc`; O(pool), restore-path only."""
+        free = set(self._free)
+        if len(set(pages)) != len(pages) or not all(p in free for p in pages):
+            return False
+        claim = set(pages)
+        self._free = [p for p in self._free if p not in claim]
+        if self.in_use > self.peak_in_use:
+            self.peak_in_use = self.in_use
+        return True
+
     def free(self, pages: list[int]) -> None:
         self._free.extend(pages)
 
@@ -692,6 +705,136 @@ class PagedBatchEngine:
             key, t_first = first_emit
             self.emit_lag_s[key] = time.perf_counter() - t_first
         return emitted
+
+    # -- checkpoint / restore / migration ------------------------------------
+
+    def checkpoint_state(self) -> dict:
+        """JSON-able snapshot of every live stream: slot metadata, page
+        grants, per-slot last token and position. Call between step()s —
+        a window boundary, where host slots and device vectors agree.
+        Pool CONTENTS are not included; :meth:`save_pools` covers engines
+        whose decode reads KV (the stub's affine rule does not)."""
+        np = self._np
+        toks = np.asarray(self.tokens)
+        pos = np.asarray(self.positions)
+        slots = []
+        for b, s in enumerate(self.slots):
+            if s is None:
+                continue
+            slots.append(
+                {
+                    "slot": b,
+                    "request_id": s.request_id,
+                    "emitted": s.emitted,
+                    "max_new": s.max_new,
+                    "pages": [int(p) for p in s.pages],
+                    "prompt": list(s.prompt) if s.prompt is not None else None,
+                    "true_len": s.true_len,
+                    "chunk_base": s.chunk_base,
+                    "decode": bool(self._decode[b]),
+                    "last_token": int(toks[b]),
+                    "position": int(pos[b]),
+                }
+            )
+        return {"slots": slots}
+
+    def restore_state(self, state: dict, *, pin_slots: bool = True) -> list[str]:
+        """Rebuild live streams from :meth:`checkpoint_state`; returns
+        the restored request ids.
+
+        Decoding streams resume from ``(last_token, position)`` — with
+        ``pin_slots`` they reclaim their exact slot index and page ids
+        (required when pool contents were restored via
+        :meth:`restore_pools`: the block tables reference physical
+        pages); without, any free slot/pages serve (the migrate-in path,
+        where pools are not shipped). Mid-prefill streams re-submit from
+        scratch — chunked prefill is deterministic and they emitted
+        nothing yet, so replaying the chunks is token-exact."""
+        jnp = self._jnp
+        restored: list[str] = []
+        metas = state.get("slots", [])
+        # Decoding slots first: with pin_slots their index is fixed, and
+        # a prefill re-submit must not claim it out from under them.
+        for meta in sorted(metas, key=lambda m: not m.get("decode")):
+            if not meta.get("decode"):
+                self.submit(meta["request_id"], meta["prompt"], meta["max_new"])
+                restored.append(meta["request_id"])
+                continue
+            n_pages = len(meta["pages"])
+            if pin_slots:
+                b = meta["slot"]
+                pages = [int(p) for p in meta["pages"]]
+                if self.slots[b] is not None or not self.allocator.take(pages):
+                    raise RuntimeError(
+                        f"cannot restore stream {meta['request_id']!r}: "
+                        f"slot {b} or its pages are busy"
+                    )
+            else:
+                if self.free_slots == 0:
+                    raise RuntimeError(
+                        f"no free slot for migrated stream "
+                        f"{meta['request_id']!r}"
+                    )
+                pages = self.allocator.alloc(n_pages)
+                if pages is None:
+                    raise RuntimeError(
+                        f"no pages for migrated stream {meta['request_id']!r}"
+                    )
+                b = self.slots.index(None)
+            self._bt[b, :] = 0
+            self._bt[b, :n_pages] = pages
+            self.slots[b] = _PagedSlot(
+                meta["request_id"],
+                emitted=meta["emitted"],
+                max_new=meta["max_new"],
+                pages=pages,
+                prompt=None,
+                true_len=meta["true_len"],
+                chunk_base=meta["chunk_base"],
+            )
+            self._decode[b] = True
+            self.tokens, self.positions = self._set_slot(
+                self.tokens,
+                self.positions,
+                jnp.asarray(meta["last_token"], jnp.int32),
+                jnp.asarray(meta["position"], jnp.int32),
+                jnp.asarray(b, jnp.int32),
+            )
+            self._bt_dirty = True
+            self._members_dirty = True
+            restored.append(meta["request_id"])
+        return restored
+
+    def drain_streams(self) -> dict:
+        """Serialize every live stream and release its slot/pages — the
+        migrate-out half of live migration. Call between step()s (a
+        window boundary); feed the result to :meth:`admit_streams` on
+        the target engine."""
+        state = self.checkpoint_state()
+        for b, s in enumerate(self.slots):
+            if s is not None:
+                self._free_slot(b)
+        self._prefillq.clear()
+        return state
+
+    def admit_streams(self, state: dict) -> list[str]:
+        """Admit streams drained from another engine (migrate-in). Slot
+        indices and page ids are re-granted fresh; without KV-page
+        transfer this is token-exact only for engines whose step depends
+        on (token, position) alone — see KNOWN_ISSUES."""
+        return self.restore_state(state, pin_slots=False)
+
+    def save_pools(self, path) -> None:
+        """Persist the KV pool pytree (orbax, models/checkpoint.py) —
+        needed only for engines whose decode reads the pool."""
+        from dora_tpu.models import checkpoint
+
+        checkpoint.save(path, self.pools)
+
+    def restore_pools(self, path) -> None:
+        from dora_tpu.models import checkpoint
+
+        self.pools = checkpoint.restore(path, self.pools)
 
 
 def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,

@@ -282,6 +282,10 @@ class EventStream:
             return Event(type="STOP")
         if isinstance(inner, d2n.Reload):
             return Event(type="RELOAD", operator_id=inner.operator_id)
+        if isinstance(inner, d2n.Migrate):
+            return Event(
+                type="MIGRATE", metadata={"handoff_dir": inner.handoff_dir}
+            )
         return None
 
     def _queue_ack(self, token: str) -> None:

@@ -45,6 +45,16 @@ Serving metrics (slots, free pages, backlog, decode tokens/s, TTFT
 histogram) ship to the daemon every second and surface in
 ``dora-tpu metrics [--watch]``.
 
+Elastic recovery (paged engines): ``DORA_CHECKPOINT_DIR`` (+
+``DORA_CHECKPOINT_EVERY``, default 8 windows) snapshots live serving
+state atomically — and on SIGTERM — and restores it on respawn,
+resuming mid-generation streams token-identically; every response
+chunk carries a ``seq`` so consumers dedup the at-least-once replay.
+``DORA_MIGRATE_DIR`` makes this node a migration target: it stays
+alive past end-of-stream and admits handoff files drained by
+``dora-tpu migrate`` from another engine, continuing each stream
+under its original trace id.
+
 Dataflow usage::
 
     - id: llm
@@ -128,19 +138,47 @@ class AdmissionQueue:
                 self._on_admit(key, self._clock() - t_in)
             self._start(key, ids, max_new)
 
+    def pending(self) -> list[tuple[str, list[int], int]]:
+        """Parked requests, in order — serialized into checkpoints and
+        migration handoffs (the wait-start time is process-local and
+        deliberately dropped)."""
+        return [(k, list(ids), mn) for k, ids, mn, _ in self._q]
+
+    def take_all(self) -> list[tuple[str, list[int], int]]:
+        """Drain the backlog without starting anything (migrate-out:
+        parked requests travel with the live streams)."""
+        out = self.pending()
+        self._q.clear()
+        return out
+
 
 def _run_loop(node, engine, backlog, metrics, handle_input, emit,
-              report, clock=time.monotonic) -> None:
+              report, clock=time.monotonic, on_tick=None, on_step=None,
+              handle_migrate=None, on_engine_error=None,
+              keep_alive=False) -> None:
     """Window-granular serving loop, factored out of :func:`main` so
     tests can drive it with fake nodes/engines. Each iteration: drain
     one event, run one engine step (one prefill chunk + one K-tick
     decode window), then ALWAYS drain the backlog — capacity appears
     when a step frees slots/pages, but also the idle path must admit
     (a parked request with zero active streams used to sit until
-    unrelated traffic arrived)."""
+    unrelated traffic arrived).
+
+    Recovery hooks (all optional, wired by :func:`serve` when the env
+    enables them): ``on_tick()`` runs first each iteration and returns
+    True to stop (SIGTERM checkpoint), ``on_step()`` runs after a step's
+    tokens are emitted (checkpoint cadence — never between step and
+    emit, where the snapshot would count tokens the wire never saw),
+    ``handle_migrate(event)`` drains live streams at this window
+    boundary, ``on_engine_error()`` fails in-flight requests before a
+    step exception propagates. ``keep_alive`` parks instead of exiting
+    when the input stream ends (migration targets wait for handoffs
+    until STOP)."""
     last_step_end: float | None = None
     report_last = clock()
     while True:
+        if on_tick is not None and on_tick():
+            break
         # Active decode: poll only (the engine must keep stepping);
         # idle: park in recv (bounded — recv returns None on timeout,
         # so the idle path below still runs a few times a second).
@@ -151,12 +189,18 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
             and engine.active == 0
             and len(backlog) == 0
         ):
-            break
+            if not keep_alive:
+                break
+            # Stream closed but handoffs may still arrive: don't spin
+            # (recv returns immediately once the queue is closed).
+            time.sleep(0.05)
         if event is not None:
             if event["type"] == "STOP":
                 break
             if event["type"] == "INPUT":
                 handle_input(event)
+            elif event["type"] == "MIGRATE" and handle_migrate is not None:
+                handle_migrate(event)
         if engine.active:
             now = clock()
             if last_step_end is not None:
@@ -164,9 +208,17 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
                 # and the start of this one: the gap the K-window
                 # exists to amortize (p50/p99 in the SERVING table).
                 metrics.dispatch_gap.observe((now - last_step_end) * 1e6)
-            for key, token, done in engine.step():
+            try:
+                stepped = engine.step()
+            except Exception:
+                if on_engine_error is not None:
+                    on_engine_error()
+                raise
+            for key, token, done in stepped:
                 emit(key, token, done)
             last_step_end = clock()
+            if on_step is not None:
+                on_step()
         else:
             last_step_end = None  # a gap across idle is queue wait
         backlog.drain()
@@ -201,6 +253,12 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     engine.serving_metrics = metrics
     telemetry.install_compile_listener()
     paged = hasattr(engine, "free_pages")
+    # Elastic-recovery env knobs; all off by default, and only engines
+    # exposing the checkpoint surface (paged) can use them.
+    can_ckpt = hasattr(engine, "checkpoint_state")
+    ckpt_dir = os.environ.get("DORA_CHECKPOINT_DIR") if can_ckpt else None
+    ckpt_every = int(os.environ.get("DORA_CHECKPOINT_EVERY", "8") or 0)
+    migrate_dir = os.environ.get("DORA_MIGRATE_DIR") if can_ckpt else None
     #: engine key -> wire request_id. The ENGINE key is always unique
     #: (req-N): two in-flight requests carrying the same wire
     #: ``request_id`` must not share a slot key, or their token streams
@@ -210,6 +268,16 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     #: engine key -> arrival wall time, pending first token (TTFT)
     t_admitted: dict[str, float] = {}
     req_counter = [0]
+    #: engine key -> next chunk sequence number. Recovery replays are
+    #: at-least-once: after a crash-restore the engine re-decodes from
+    #: the checkpoint, re-emitting chunks the wire already saw — with
+    #: the SAME (request_id, seq) pair, so consumers dedup instead of
+    #: double-printing.
+    seqs: dict[str, int] = {}
+    #: wire request_ids already admitted (checkpoint mode only): a
+    #: daemon replay of an un-acked input must not re-admit a stream
+    #: the restored engine is already running.
+    seen_rids: dict[str, None] = {}
 
     def emit_text(
         key: str, text: str, done: bool, finish: str | None = None
@@ -219,6 +287,12 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             # Done-by-EOS ("stop") vs done-by-cap ("length"): the server
             # reports this as the OpenAI finish_reason.
             meta["finish"] = finish or "stop"
+        seq = seqs.get(key, 0)
+        meta["seq"] = seq
+        if done:
+            seqs.pop(key, None)
+        else:
+            seqs[key] = seq + 1
         rid = wire_ids.get(key)
         if rid is not None:
             meta["request_id"] = rid
@@ -267,6 +341,16 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
 
         meta = event.get("metadata") or {}
         rid = meta.get("request_id")
+        if ckpt_dir and rid is not None:
+            # Checkpoint mode: the daemon replays un-acked inputs after
+            # a respawn; a rid the restored engine already owns must not
+            # be admitted twice.
+            if rid in seen_rids:
+                tracer.instant("s_reject", f"req:{rid}", "replay-dup")
+                return
+            seen_rids[rid] = None
+            while len(seen_rids) > 4096:
+                seen_rids.pop(next(iter(seen_rids)))
         value = event["value"]
         text = (
             value.to_pylist()[0]
@@ -334,12 +418,262 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         except Exception:
             pass  # metrics are best-effort; serving never blocks on them
 
+    # ------------------------------------------------------------------
+    # elastic recovery: checkpoint/restore, drain-and-migrate, SIGTERM
+    # ------------------------------------------------------------------
+    import json
+
+    def write_checkpoint(reason: str) -> None:
+        """Snapshot everything a respawn needs to resume mid-generation
+        token-identically. Written atomically (tmp + rename) so a kill
+        mid-write leaves the previous snapshot intact. Only ever called
+        at a window boundary — never between step() and emit, where the
+        engine's emitted counters would count tokens the wire hasn't
+        seen (restore must produce duplicates, never gaps)."""
+        t0 = clock()
+        state = {
+            "engine": engine.checkpoint_state(),
+            "backlog": [
+                [k, list(ids), mn] for k, ids, mn in backlog.pending()
+            ],
+            "wire_ids": dict(wire_ids),
+            "seqs": dict(seqs),
+            "ctxs": {k: tracer.context(k) for k in wire_ids},
+            "req_counter": req_counter[0],
+            "seen_rids": list(seen_rids),
+        }
+        os.makedirs(ckpt_dir, exist_ok=True)
+        tmp = os.path.join(ckpt_dir, "state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(ckpt_dir, "state.json"))
+        if os.environ.get("DORA_CHECKPOINT_PAGES") == "1":
+            # KV page pools via orbax, for engines whose decode reads
+            # the cache. Best-effort: pool persistence failing must not
+            # take serving down with it.
+            try:
+                engine.save_pools(os.path.join(ckpt_dir, "pools"))
+            except Exception:
+                pass
+        metrics.checkpoints += 1
+        metrics.last_checkpoint_unix = time.time()
+        tracer.span(
+            "s_checkpoint", "(engine)",
+            f"streams={len(state['engine']['slots'])} {reason}",
+            dur_ns=int((clock() - t0) * 1e9),
+        )
+
+    def restore_checkpoint() -> None:
+        spath = os.path.join(ckpt_dir, "state.json")
+        if not os.path.exists(spath):
+            return
+        t0 = clock()
+        with open(spath) as f:
+            saved = json.load(f)
+        pools = os.path.join(ckpt_dir, "pools")
+        if os.environ.get("DORA_CHECKPOINT_PAGES") == "1" and os.path.isdir(
+            pools
+        ):
+            try:
+                engine.restore_pools(pools)
+            except Exception:
+                pass
+        req_counter[0] = int(saved.get("req_counter", 0))
+        wire_ids.update(saved.get("wire_ids") or {})
+        seqs.update(
+            {k: int(v) for k, v in (saved.get("seqs") or {}).items()}
+        )
+        for rid in saved.get("seen_rids") or []:
+            seen_rids[rid] = None
+        # Same context => same trace id: the resumed stream's spans
+        # continue the pre-crash chain on the timeline.
+        for k, ctx in (saved.get("ctxs") or {}).items():
+            tracer.begin(k, ctx or "")
+        restored = engine.restore_state(saved.get("engine") or {"slots": []})
+        for k, ids, mn in saved.get("backlog") or []:
+            backlog.push(k, list(ids), int(mn))
+        metrics.restored_streams += len(restored)
+        tracer.span(
+            "s_restore", "(engine)", f"streams={len(restored)}",
+            dur_ns=int((clock() - t0) * 1e9),
+        )
+
+    migrations = [0]
+
+    def handle_migrate(event) -> None:
+        """Drain every live stream (and the parked backlog) into a
+        handoff file another engine's ``DORA_MIGRATE_DIR`` poll admits.
+        Runs at a window boundary, so clients see at most one window of
+        added latency."""
+        handoff_dir = (event.get("metadata") or {}).get("handoff_dir", "")
+        if not handoff_dir or not can_ckpt:
+            return
+        t0 = clock()
+        state = engine.drain_streams()
+        parked = backlog.take_all()
+        keys = [m["request_id"] for m in state["slots"]]
+        keys += [k for k, _ids, _mn in parked]
+        payload = {
+            "engine": state,
+            "backlog": [[k, list(ids), mn] for k, ids, mn in parked],
+            "wire_ids": {k: wire_ids.get(k) for k in keys},
+            "seqs": {k: seqs.get(k, 0) for k in keys},
+            "ctxs": {k: tracer.context(k) for k in keys},
+        }
+        migrations[0] += 1
+        fname = f"streams-{os.getpid()}-{migrations[0]}.json"
+        os.makedirs(handoff_dir, exist_ok=True)
+        tmp = os.path.join(handoff_dir, fname + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(handoff_dir, fname))
+        dur = int((clock() - t0) * 1e9)
+        for k in keys:
+            # Span BEFORE release: it must carry the stream's trace id
+            # so the migrate-out leg links to the same chain the target
+            # continues. No s_finish here — the stream isn't done, it
+            # moved.
+            tracer.span("s_migrate_out", k, f"dir={handoff_dir}", dur_ns=dur)
+            tracer.release(k)
+            wire_ids.pop(k, None)
+            seqs.pop(k, None)
+            t_admitted.pop(k, None)
+        metrics.migrated_out += len(keys)
+
+    def _admit_handoff(payload: dict, src: str) -> None:
+        t0 = clock()
+        mapping: dict[str, str] = {}
+
+        def fresh(old: str) -> str:
+            # Local keys are req-N; a migrated-in req-N from another
+            # engine could collide, so every incoming stream gets a
+            # fresh local key. The wire request_id and seq counter
+            # travel untouched — dedup and SSE routing don't notice.
+            req_counter[0] += 1
+            nk = f"req-{req_counter[0]}"
+            mapping[old] = nk
+            return nk
+
+        state = payload.get("engine") or {"slots": []}
+        for m in state["slots"]:
+            m["request_id"] = fresh(m["request_id"])
+        parked = [
+            (fresh(k), list(ids), int(mn))
+            for k, ids, mn in payload.get("backlog") or []
+        ]
+        src_wire = payload.get("wire_ids") or {}
+        src_seqs = payload.get("seqs") or {}
+        src_ctxs = payload.get("ctxs") or {}
+        for old, nk in mapping.items():
+            wire_ids[nk] = src_wire.get(old)
+            seqs[nk] = int(src_seqs.get(old, 0))
+            # begin() with the source's serialized context keeps the
+            # trace id — ONE contiguous trace spans both engines.
+            tracer.begin(nk, src_ctxs.get(old) or "")
+        engine.admit_streams(state)
+        for nk, ids, mn in parked:
+            backlog.push(nk, ids, mn)
+        dur = int((clock() - t0) * 1e9)
+        for nk in mapping.values():
+            tracer.span("s_migrate_in", nk, f"from={src}", dur_ns=dur)
+        metrics.migrated_in += len(mapping)
+
+    def poll_migrate_in() -> None:
+        try:
+            names = sorted(os.listdir(migrate_dir))
+        except OSError:
+            return
+        for fname in names:
+            if not (fname.startswith("streams-")
+                    and fname.endswith(".json")):
+                continue
+            path = os.path.join(migrate_dir, fname)
+            claimed = path + ".claimed"
+            try:
+                os.rename(path, claimed)  # atomic claim
+            except OSError:
+                continue
+            try:
+                with open(claimed) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue
+            _admit_handoff(payload, fname)
+            try:
+                os.remove(claimed)
+            except OSError:
+                pass
+
+    stop_now = [False]
+    step_count = [0]
+    engine_failed = [False]
+
+    def on_tick() -> bool:
+        if migrate_dir:
+            poll_migrate_in()
+        if stop_now[0]:
+            if ckpt_dir:
+                try:
+                    write_checkpoint("sigterm")
+                except Exception:
+                    pass
+            return True
+        return False
+
+    def on_step() -> None:
+        step_count[0] += 1
+        if ckpt_every > 0 and step_count[0] % ckpt_every == 0:
+            write_checkpoint("cadence")
+
+    def on_engine_error() -> None:
+        # A wedged engine must not leave SSE streams dangling: every
+        # in-flight request (active or parked) closes with a retriable
+        # "error" finish before the exception propagates and the
+        # restart policy respawns the node.
+        engine_failed[0] = True
+        for key in list(wire_ids):
+            try:
+                emit_text(key, "", True, finish="error")
+            except Exception:
+                pass
+
+    recovery_on = bool(ckpt_dir or migrate_dir)
+    if ckpt_dir:
+        import signal
+
+        def _term(signum, frame):
+            # Graceful drain: the loop checkpoints and exits cleanly on
+            # the next tick instead of dying mid-window.
+            stop_now[0] = True
+
+        try:
+            signal.signal(signal.SIGTERM, _term)
+        except (ValueError, OSError):
+            pass  # not the main thread (test harness)
+        restore_checkpoint()
+
+    clean = False
     try:
         _run_loop(
             node, engine, backlog, metrics, handle_input, emit, report,
             clock=clock,
+            on_tick=on_tick if recovery_on else None,
+            on_step=on_step if ckpt_dir else None,
+            handle_migrate=handle_migrate if can_ckpt else None,
+            on_engine_error=on_engine_error,
+            keep_alive=bool(migrate_dir),
         )
+        clean = True
     finally:
+        # Only a CLEAN exit snapshots: after a crash (engine wedge, lost
+        # daemon, anything that raised out of the loop) the last cadence
+        # checkpoint is the trustworthy state — overwriting it with a
+        # post-crash "exit" snapshot would resume from poisoned state.
+        if ckpt_dir and clean and not engine_failed[0]:
+            try:
+                write_checkpoint("exit")
+            except Exception:
+                pass
         report(clock())
         node.close()
 
@@ -358,6 +692,19 @@ def _stub_main() -> None:
         max_slots=int(os.environ.get("DORA_BATCH_SLOTS", "4")),
         window=int(os.environ.get("DORA_MULTISTEP_K", "4")),
     )
+    delay = float(os.environ.get("DORA_STEP_DELAY_S", "0") or 0)
+    if delay > 0:
+        # Chaos-harness hook: the stub decodes in microseconds, far too
+        # fast to land a mid-generation kill deterministically. A
+        # per-window sleep stretches generation into a predictable
+        # strike window without touching token content.
+        orig_step = engine.step
+
+        def _throttled_step():
+            time.sleep(delay)
+            return orig_step()
+
+        engine.step = _throttled_step
     serve(
         Node(), engine, ServingMetrics(engine="paged"),
         encode=lambda text: [ord(ch) % 97 for ch in text] or [1],

@@ -353,6 +353,17 @@ class ServingTracer:
             return
         self._flight.record("s_finish", f"{key} {reason}", ctx, 0)
 
+    def context(self, key: str) -> str:
+        """The request's serialized trace context (empty when tracing is
+        off or the key is unknown). Checkpoint/migration handoffs carry
+        this so the resumed stream keeps the same trace id."""
+        return self._ctx.get(key) or ""
+
+    def release(self, key: str) -> None:
+        """Drop a request's context without an ``s_finish`` span — for
+        streams that migrate away rather than finishing here."""
+        self._ctx.pop(key, None)
+
 
 # ---------------------------------------------------------------------------
 # XLA compile audit (runtime promotion of the tier-1 compile listener)

@@ -1,0 +1,136 @@
+"""Fault-injection harness: kill a chosen dataflow node mid-run.
+
+Every node process spawned by the daemon carries ``DORA_CHAOS_ID`` in
+its environment, set to ``<dataflow-id>:<node-id>`` (daemon/spawn.py).
+This tool finds victims by scanning ``/proc/*/environ`` for that marker
+— no pid files, no cooperation from the victim — and delivers a signal
+(SIGKILL by default: the point is to exercise the UNGRACEFUL paths,
+respawn + replay + checkpoint restore).
+
+CLI::
+
+    python -m dora_tpu.tools.chaos --victim <dataflow>:<node> \
+        [--after 1.5] [--signal 9] [--timeout 30] [--seed 7]
+
+``--after`` sleeps before striking (with ±20 % seeded jitter when
+``--seed`` is given, so chaos schedules are reproducible but not
+phase-locked to the dataflow). ``--timeout`` bounds the wait for the
+victim to appear; exit code 1 if it never does.
+
+The module is import-friendly for tests: ``find_pids`` / ``wait_for`` /
+``kill`` are plain functions with no side effects at import time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal as _signal
+import sys
+import time
+
+CHAOS_ENV = "DORA_CHAOS_ID"
+
+
+def _environ_of(pid: str) -> dict[str, str]:
+    try:
+        raw = open(f"/proc/{pid}/environ", "rb").read()
+    except OSError:
+        return {}
+    out: dict[str, str] = {}
+    for chunk in raw.split(b"\0"):
+        if b"=" in chunk:
+            k, _, v = chunk.partition(b"=")
+            out[k.decode(errors="replace")] = v.decode(errors="replace")
+    return out
+
+
+def find_pids(dataflow_id: str | None = None,
+              node_id: str | None = None) -> list[int]:
+    """Pids whose ``DORA_CHAOS_ID`` matches ``<dataflow>:<node>``.
+
+    ``None`` wildcards either half: ``find_pids(node_id="llm")`` finds
+    the llm node of whatever dataflow is running."""
+    hits: list[int] = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        marker = _environ_of(entry).get(CHAOS_ENV)
+        if not marker or ":" not in marker:
+            continue
+        df, _, node = marker.rpartition(":")
+        if dataflow_id is not None and df != dataflow_id:
+            continue
+        if node_id is not None and node != node_id:
+            continue
+        hits.append(int(entry))
+    return hits
+
+
+def wait_for(dataflow_id: str | None, node_id: str | None,
+             timeout_s: float = 30.0,
+             poll_s: float = 0.1) -> list[int]:
+    """Poll until at least one matching victim exists (or timeout)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        pids = find_pids(dataflow_id, node_id)
+        if pids:
+            return pids
+        time.sleep(poll_s)
+    return []
+
+
+def kill(pids: list[int], sig: int = _signal.SIGKILL) -> list[int]:
+    """Deliver ``sig`` to each pid; returns the pids actually hit
+    (a victim may have exited between discovery and delivery)."""
+    struck: list[int] = []
+    for pid in pids:
+        try:
+            os.kill(pid, sig)
+            struck.append(pid)
+        except OSError:
+            pass
+    return struck
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dora-tpu-chaos",
+        description="kill -9 a dataflow node mid-run (fault injection)",
+    )
+    parser.add_argument(
+        "--victim", required=True, metavar="DATAFLOW:NODE",
+        help="target as <dataflow-id>:<node-id>; either half may be '*'",
+    )
+    parser.add_argument("--after", type=float, default=0.0,
+                        help="seconds to wait before striking")
+    parser.add_argument("--signal", type=int, default=int(_signal.SIGKILL),
+                        help="signal number (default 9)")
+    parser.add_argument("--timeout", type=float, default=30.0,
+                        help="max seconds to wait for the victim to appear")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="seed the strike-time jitter (reproducible runs)")
+    args = parser.parse_args(argv)
+
+    df, _, node = args.victim.rpartition(":")
+    df_id = None if df in ("", "*") else df
+    node_id = None if node in ("", "*") else node
+
+    delay = args.after
+    if args.seed is not None and delay > 0:
+        delay *= 0.8 + 0.4 * random.Random(args.seed).random()
+    if delay > 0:
+        time.sleep(delay)
+
+    pids = wait_for(df_id, node_id, timeout_s=args.timeout)
+    if not pids:
+        print(f"chaos: no victim matching {args.victim!r}", file=sys.stderr)
+        return 1
+    struck = kill(pids, args.signal)
+    print(f"chaos: sent signal {args.signal} to {struck}")
+    return 0 if struck else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,14 @@ SERVING_SPAN_KINDS = {
     "s_prefill_chunk": "prefill_chunk",
     "s_decode_window": "decode_window",
     "s_finish": "finish",
+    # Elastic recovery: checkpoint write / restore-on-respawn, and the
+    # two halves of a drain-and-migrate handoff. migrate_out/migrate_in
+    # share the request's trace context, so a migrated stream shows ONE
+    # contiguous trace id across both engines' tracks.
+    "s_checkpoint": "checkpoint",
+    "s_restore": "restore",
+    "s_migrate_out": "migrate_out",
+    "s_migrate_in": "migrate_in",
 }
 
 #: Hot-path flight events surfaced as instants (everything else recorded
@@ -68,6 +76,9 @@ INSTANT_NAMES = {
     "s_page_wait": "page wait",
     "xla_compile": "xla compile",
     "trace_truncated": "trace truncated",
+    "node_respawn": "node respawn",
+    "replay_inputs": "replay inputs",
+    "daemon_reconnect": "daemon reconnect",
 }
 
 #: Instants that belong on the engine track and may carry a request

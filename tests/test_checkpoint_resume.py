@@ -1,0 +1,275 @@
+"""Serving-state checkpoint/resume: engine snapshots restore
+token-identically mid-generation, drain/admit moves live streams between
+engines, and the serve() loop resumes a crashed node from its last
+cadence checkpoint with (request_id, seq)-dedup producing byte-identical
+output. Also the engine-failure path: in-flight requests close with a
+retriable ``finish="error"`` instead of dangling."""
+
+from __future__ import annotations
+
+import json
+import signal
+
+import pytest
+
+from dora_tpu.metrics import ServingMetrics
+from tests.test_serving_trace import _ServeNode, _req
+
+
+def _mk_engine(max_slots: int = 2):
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    return make_stub_paged_engine(
+        max_slots=max_slots, max_seq=64, page_size=8, chunk=16, window=1
+    )
+
+
+def _run_to_done(engine, tokens: dict[str, list[int]], max_steps=200) -> None:
+    """Step until every stream finished, appending tokens per request."""
+    for _ in range(max_steps):
+        if engine.active == 0 and not getattr(engine, "_prefillq", None):
+            return
+        for key, token, done in engine.step():
+            tokens.setdefault(key, []).append(int(token))
+    raise AssertionError("engine did not finish")
+
+
+def _reference_tokens() -> dict[str, list[int]]:
+    ref = _mk_engine()
+    ref.submit("r0", [5], 10)
+    ref.submit("r1", [9], 10)
+    tokens: dict[str, list[int]] = {}
+    _run_to_done(ref, tokens)
+    assert len(tokens["r0"]) == 10 and len(tokens["r1"]) == 10
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# engine layer: snapshot / restore / drain / admit token identity
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restore_token_identical():
+    """Tokens emitted before the snapshot plus tokens emitted by a fresh
+    engine restored from it concatenate to exactly the uninterrupted
+    reference stream — the mid-generation resume contract."""
+    ref = _reference_tokens()
+
+    a = _mk_engine()
+    a.submit("r0", [5], 10)
+    a.submit("r1", [9], 10)
+    pre: dict[str, list[int]] = {}
+    for _ in range(4):
+        for key, token, done in a.step():
+            pre.setdefault(key, []).append(int(token))
+    snap = a.checkpoint_state()
+    # JSON round-trip: the snapshot must survive the state.json file.
+    snap = json.loads(json.dumps(snap))
+
+    b = _mk_engine()
+    restored = b.restore_state(snap)
+    assert set(restored) == {"r0", "r1"}
+    post: dict[str, list[int]] = {}
+    _run_to_done(b, post)
+    for rid in ("r0", "r1"):
+        assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+
+
+def test_drain_admit_streams_token_identical():
+    """drain_streams releases every slot/page on the source; admit on a
+    second engine continues each stream token-identically (fresh slots,
+    fresh pages — the migrate-in path never pins physical ids)."""
+    ref = _reference_tokens()
+
+    a = _mk_engine()
+    a.submit("r0", [5], 10)
+    a.submit("r1", [9], 10)
+    pre: dict[str, list[int]] = {}
+    for _ in range(3):
+        for key, token, done in a.step():
+            pre.setdefault(key, []).append(int(token))
+    state = a.drain_streams()
+    assert a.active == 0
+    assert a.free_pages == a.allocator.num_pages - 1  # every page back
+
+    b = _mk_engine()
+    admitted = b.admit_streams(json.loads(json.dumps(state)))
+    assert set(admitted) == {"r0", "r1"}
+    post: dict[str, list[int]] = {}
+    _run_to_done(b, post)
+    for rid in ("r0", "r1"):
+        assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+
+
+def test_page_allocator_take_specific_pages():
+    from dora_tpu.models.batch_engine import PageAllocator
+
+    alloc = PageAllocator(8)
+    assert alloc.take([1, 2])
+    assert alloc.in_use == 2
+    assert not alloc.take([2, 3])  # 2 already granted: all-or-nothing
+    assert not alloc.take([4, 4])  # duplicate ids rejected
+    assert alloc.in_use == 2  # failed takes granted nothing
+    assert alloc.take([3, 4])
+    assert alloc.in_use == 4
+
+
+# ---------------------------------------------------------------------------
+# serve() layer: crash mid-generation, resume from cadence checkpoint
+# ---------------------------------------------------------------------------
+
+
+class _CrashNode(_ServeNode):
+    """Delivers its events, then raises out of recv after ``crash_after``
+    calls — the in-process stand-in for kill -9 mid-generation."""
+
+    def __init__(self, events, crash_after: int):
+        super().__init__(events)
+        self._calls = 0
+        self._crash_after = crash_after
+
+    def recv(self, timeout=None):
+        self._calls += 1
+        if self._calls > self._crash_after:
+            raise RuntimeError("simulated kill")
+        if self._events:
+            return self._events.pop(0)
+        return None  # stream stays open: more polls until the "kill"
+
+
+def _expected_text(prompt: str, max_new: int) -> str:
+    """Analytic stub output: affine chain from the last prompt id."""
+    ids = [ord(ch) % 97 for ch in prompt] or [1]
+    t = ids[-1]
+    out = []
+    for _ in range(max_new):
+        t = (7 * t + 3) % 97
+        out.append(f" t{t}")
+    return "".join(out)
+
+
+def _merge_chunks(*nodes) -> dict[str, str]:
+    """Dedup response chunks by (request_id, seq) keeping the FIRST
+    occurrence — the consumer contract that turns at-least-once replay
+    into byte-identical streams."""
+    seen: dict[tuple[str, int], str] = {}
+    for node in nodes:
+        for _out, value, meta in node.sent:
+            rid = meta.get("request_id")
+            if rid is None:
+                continue
+            seen.setdefault((rid, int(meta["seq"])), value.to_pylist()[0])
+    texts: dict[str, str] = {}
+    for (rid, seq) in sorted(seen):
+        texts[rid] = texts.get(rid, "") + seen[(rid, seq)]
+    return texts
+
+
+def test_serve_crash_and_resume_byte_identical(tmp_path, monkeypatch):
+    """serve() checkpointing every window dies mid-generation (recv
+    raises); a second serve() over a FRESH engine restores the snapshot
+    and completes both streams. Merged chunks, deduped by
+    (request_id, seq), equal the analytic uninterrupted output."""
+    from dora_tpu.nodehub.llm_server import serve
+
+    monkeypatch.setenv("DORA_CHECKPOINT_DIR", str(tmp_path / "ckpt"))
+    monkeypatch.setenv("DORA_CHECKPOINT_EVERY", "1")
+    prev_term = signal.getsignal(signal.SIGTERM)
+    kwargs = dict(
+        encode=lambda text: [ord(ch) % 97 for ch in text] or [1],
+        decode_one=lambda t: f" t{t}",
+        max_new_cap=8,
+    )
+    try:
+        node1 = _CrashNode([_req("ab", 8), _req("cd", 8)], crash_after=6)
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            serve(node1, _mk_engine(), ServingMetrics(), **kwargs)
+        assert (tmp_path / "ckpt" / "state.json").exists()
+        # The crash must NOT have produced complete streams on its own.
+        done1 = [m for _o, _v, m in node1.sent if m.get("done")]
+        assert len(done1) < 2
+
+        metrics2 = ServingMetrics()
+        node2 = _ServeNode([])  # no new traffic: pure resume
+        serve(node2, _mk_engine(), metrics2, **kwargs)
+        assert metrics2.restored_streams == 2
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+
+    texts = _merge_chunks(node1, node2)
+    assert texts == {
+        "wire-ab": _expected_text("ab", 8),
+        "wire-cd": _expected_text("cd", 8),
+    }
+
+
+def test_serve_replayed_input_not_readmitted(tmp_path, monkeypatch):
+    """Checkpoint mode dedups daemon input replay by wire request_id: a
+    rid the restored engine already owns is dropped, not double-run."""
+    from dora_tpu.nodehub.llm_server import serve
+
+    monkeypatch.setenv("DORA_CHECKPOINT_DIR", str(tmp_path / "ckpt"))
+    monkeypatch.setenv("DORA_CHECKPOINT_EVERY", "1")
+    prev_term = signal.getsignal(signal.SIGTERM)
+    kwargs = dict(
+        encode=lambda text: [ord(ch) % 97 for ch in text] or [1],
+        decode_one=lambda t: f" t{t}",
+        max_new_cap=8,
+    )
+    try:
+        node1 = _CrashNode([_req("ab", 8)], crash_after=4)
+        with pytest.raises(RuntimeError):
+            serve(node1, _mk_engine(), ServingMetrics(), **kwargs)
+
+        # The daemon replays the un-acked input after respawn: same rid.
+        metrics2 = ServingMetrics()
+        node2 = _ServeNode([_req("ab", 8)])
+        serve(node2, _mk_engine(), metrics2, **kwargs)
+        assert metrics2.restored_streams == 1
+        assert metrics2.requests == 0  # replayed rid rejected, not re-run
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+
+    texts = _merge_chunks(node1, node2)
+    assert texts == {"wire-ab": _expected_text("ab", 8)}
+
+
+# ---------------------------------------------------------------------------
+# engine failure: in-flight requests fail retriable, never dangle
+# ---------------------------------------------------------------------------
+
+
+def test_engine_exception_fails_inflight_with_error_finish():
+    """When the engine wedges mid-step, every in-flight request — the
+    active stream AND the parked one — closes with a done-chunk carrying
+    ``finish="error"`` before the exception propagates (the respawn
+    policy handles the node; clients see a retriable error, not a
+    silent dead SSE stream)."""
+    from dora_tpu.nodehub.llm_server import serve
+
+    engine = _mk_engine(max_slots=1)
+    steps = [0]
+    orig_step = engine.step
+
+    def wedge():
+        steps[0] += 1
+        if steps[0] > 2:
+            raise RuntimeError("device wedged")
+        return orig_step()
+
+    engine.step = wedge
+    node = _ServeNode([_req("ab", 8), _req("cd", 8)])
+    with pytest.raises(RuntimeError, match="device wedged"):
+        serve(
+            node, engine, ServingMetrics(),
+            encode=lambda text: [ord(ch) % 97 for ch in text] or [1],
+            decode_one=lambda t: f" t{t}",
+            max_new_cap=8,
+        )
+    errors = {
+        m.get("request_id"): m.get("finish")
+        for _o, _v, m in node.sent
+        if m.get("done")
+    }
+    assert errors == {"wire-ab": "error", "wire-cd": "error"}
+    assert node.closed  # serve's finally still ran

@@ -1,0 +1,378 @@
+"""Elastic crash recovery: per-node restart policy (respawn + backoff +
+replay of un-acked inputs), failure classification pinning
+(grace_duration / cascading / other), and daemon→coordinator reconnect
+inside the heartbeat-drop window."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import textwrap
+
+import pytest
+import yaml
+
+from dora_tpu.coordinator import Coordinator
+from dora_tpu.daemon.core import Daemon, run_dataflow_async
+from dora_tpu.message import coordinator as cm
+from tests.test_trace import _wait_finished, _wait_machines
+
+
+# ---------------------------------------------------------------------------
+# restart policy parsing
+# ---------------------------------------------------------------------------
+
+
+def test_restart_policy_parse():
+    from dora_tpu.core.descriptor import RestartPolicy
+
+    assert RestartPolicy.parse(None) is None
+    assert RestartPolicy.parse(False) is None
+    assert RestartPolicy.parse(0) is None
+    assert RestartPolicy.parse(True).max_attempts == 1
+    assert RestartPolicy.parse(3).max_attempts == 3
+    policy = RestartPolicy.parse(
+        {"max_attempts": 2, "backoff_base_s": 0.1, "backoff_max_s": 1.0}
+    )
+    assert (policy.max_attempts, policy.backoff_base_s, policy.backoff_max_s) \
+        == (2, 0.1, 1.0)
+    with pytest.raises(ValueError):
+        RestartPolicy.parse({"max_attempts": 1, "bogus": True})
+    with pytest.raises(ValueError):
+        RestartPolicy.parse("yes")
+
+
+def test_restart_in_descriptor(tmp_path):
+    from dora_tpu.core.descriptor import Descriptor
+
+    spec = {
+        "nodes": [
+            {
+                "id": "a",
+                "path": "a.py",
+                "outputs": ["out"],
+                "restart": {"max_attempts": 2, "backoff_base_s": 0.05},
+            },
+            {"id": "b", "path": "b.py", "inputs": {"in": "a/out"}},
+        ]
+    }
+    descriptor = Descriptor.parse(spec)
+    assert descriptor.node("a").restart.max_attempts == 2
+    assert descriptor.node("b").restart is None
+
+
+# ---------------------------------------------------------------------------
+# respawn + replay end to end (standalone daemon)
+# ---------------------------------------------------------------------------
+
+
+CLIENT = textwrap.dedent(
+    """
+    import pyarrow as pa
+    from dora_tpu.node import Node
+
+    node = Node()
+    for i in range(6):
+        node.send_output("text", pa.array([i]), {})
+    node.close()
+    """
+)
+
+# Crashes hard (os._exit — no cleanup, no output close) after forwarding
+# two inputs, but only on its first incarnation: the sentinel file marks
+# "already crashed once".
+FLAKY = textwrap.dedent(
+    """
+    import os
+    import pyarrow as pa
+    from dora_tpu.node import Node
+
+    sentinel = os.environ["CRASH_SENTINEL"]
+    first = not os.path.exists(sentinel)
+    seen = 0
+    with Node() as node:
+        for event in node:
+            if event["type"] == "STOP":
+                break
+            if event["type"] != "INPUT":
+                continue
+            value = event["value"].to_pylist()[0]
+            node.send_output("out", pa.array([value]), {})
+            seen += 1
+            if first and seen == 2:
+                open(sentinel, "w").write("x")
+                os._exit(1)
+    """
+)
+
+SINK = textwrap.dedent(
+    """
+    import json, os
+    from dora_tpu.node import Node
+
+    got = []
+    with Node() as node:
+        for event in node:
+            if event["type"] == "STOP":
+                break
+            if event["type"] == "INPUT":
+                got.append(event["value"].to_pylist()[0])
+    open(os.environ["SINK_OUT"], "w").write(json.dumps(got))
+    """
+)
+
+
+def test_respawn_replays_unacked_inputs(tmp_path):
+    """A node that crashes mid-stream respawns under its restart policy
+    and the un-acked in-flight inputs replay — downstream sees every
+    payload (at-least-once: duplicates allowed, gaps are not)."""
+    (tmp_path / "client.py").write_text(CLIENT)
+    (tmp_path / "flaky.py").write_text(FLAKY)
+    (tmp_path / "sink.py").write_text(SINK)
+    sink_out = tmp_path / "sink_out.json"
+    spec = {
+        "nodes": [
+            {"id": "client", "path": "client.py", "outputs": ["text"]},
+            {
+                "id": "flaky",
+                "path": "flaky.py",
+                "inputs": {"text": "client/text"},
+                "outputs": ["out"],
+                "env": {"CRASH_SENTINEL": str(tmp_path / "crashed.marker")},
+                "restart": {"max_attempts": 2, "backoff_base_s": 0.05,
+                            "backoff_max_s": 0.2},
+            },
+            {
+                "id": "sink",
+                "path": "sink.py",
+                "inputs": {"fwd": "flaky/out"},
+                "env": {"SINK_OUT": str(sink_out)},
+            },
+        ]
+    }
+    path = tmp_path / "flow.yml"
+    path.write_text(yaml.safe_dump(spec))
+
+    async def main():
+        return await asyncio.wait_for(
+            run_dataflow_async(path, working_dir=tmp_path), timeout=120
+        )
+
+    result = asyncio.run(main())
+    assert result.is_ok(), result.errors()
+    assert (tmp_path / "crashed.marker").exists()  # the crash DID happen
+    got = json.loads(sink_out.read_text())
+    # every payload arrived despite the crash; replay may duplicate
+    assert set(got) == set(range(6)), got
+
+
+def test_respawn_budget_exhausted_fails(tmp_path):
+    """A node that keeps crashing exhausts max_attempts and the dataflow
+    fails with the real error (kind=other), not a hang."""
+    always_crash = textwrap.dedent(
+        """
+        import sys
+        import pyarrow as pa
+        from dora_tpu.node import Node
+
+        node = Node()
+        node.send_output("out", pa.array([1]), {})
+        print("kaboom forever", file=sys.stderr)
+        sys.exit(5)
+        """
+    )
+    (tmp_path / "crash.py").write_text(always_crash)
+    (tmp_path / "sink.py").write_text(SINK)
+    spec = {
+        "nodes": [
+            {
+                "id": "crash",
+                "path": "crash.py",
+                "outputs": ["out"],
+                "restart": {"max_attempts": 1, "backoff_base_s": 0.05,
+                            "backoff_max_s": 0.1},
+            },
+            {
+                "id": "sink",
+                "path": "sink.py",
+                "inputs": {"in": "crash/out"},
+                "env": {"SINK_OUT": str(tmp_path / "out.json")},
+            },
+        ]
+    }
+    path = tmp_path / "flow.yml"
+    path.write_text(yaml.safe_dump(spec))
+
+    async def main():
+        return await asyncio.wait_for(
+            run_dataflow_async(path, working_dir=tmp_path), timeout=120
+        )
+
+    result = asyncio.run(main())
+    assert not result.is_ok()
+    errors = dict(result.errors())
+    assert errors["crash"].cause.kind == "other"
+    assert "kaboom forever" in (errors["crash"].cause.stderr or "")
+
+
+# ---------------------------------------------------------------------------
+# failure classification pinning (satellite: grace / cascading / other)
+# ---------------------------------------------------------------------------
+
+
+def test_failure_classification_other_and_cascading(tmp_path):
+    """One node exits nonzero post-barrier -> ``other`` with its stderr;
+    a downstream node that exits nonzero when its input dies ->
+    ``cascading`` with the structured culprit id."""
+    bad = textwrap.dedent(
+        """
+        import sys
+        import pyarrow as pa
+        from dora_tpu.node import Node
+
+        node = Node()
+        node.send_output("data", pa.array([1]), {})
+        print("boom: deliberate failure", file=sys.stderr)
+        sys.exit(3)
+        """
+    )
+    victim = textwrap.dedent(
+        """
+        import sys
+        from dora_tpu.node import Node
+
+        with Node() as node:
+            for event in node:
+                if event["type"] == "STOP":
+                    break
+        sys.exit(7)
+        """
+    )
+    (tmp_path / "bad.py").write_text(bad)
+    (tmp_path / "victim.py").write_text(victim)
+    spec = {
+        "nodes": [
+            {"id": "bad", "path": "bad.py", "outputs": ["data"]},
+            {"id": "victim", "path": "victim.py",
+             "inputs": {"in": "bad/data"}},
+        ]
+    }
+    path = tmp_path / "flow.yml"
+    path.write_text(yaml.safe_dump(spec))
+
+    async def main():
+        return await asyncio.wait_for(
+            run_dataflow_async(path, working_dir=tmp_path), timeout=120
+        )
+
+    result = asyncio.run(main())
+    assert not result.is_ok()
+    errors = dict(result.errors())
+    assert errors["bad"].cause.kind == "other"
+    assert "boom: deliberate failure" in (errors["bad"].cause.stderr or "")
+    assert errors["victim"].cause.kind == "cascading"
+    assert errors["victim"].cause.caused_by_node == "bad"
+
+
+def test_failure_classification_grace_duration(tmp_path):
+    """A node that ignores both the STOP event and SIGTERM is force-killed
+    after the grace window and classified ``grace_duration``."""
+    stubborn = textwrap.dedent(
+        """
+        import signal
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        from dora_tpu.node import Node
+
+        node = Node()
+        while True:
+            node.recv(timeout=0.2)  # ignores STOP on purpose
+        """
+    )
+    (tmp_path / "stubborn.py").write_text(stubborn)
+    spec = {
+        "nodes": [
+            {
+                "id": "stubborn",
+                "path": "stubborn.py",
+                "inputs": {"tick": "dora/timer/millis/100"},
+            }
+        ]
+    }
+
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        daemon = Daemon()
+        task = asyncio.create_task(
+            daemon.run(f"127.0.0.1:{coord.daemon_port}", "A")
+        )
+        try:
+            await _wait_machines(coord, {"A"})
+            start = await coord.handle_control_request(
+                cm.Start(dataflow=spec, name=None,
+                         local_working_dir=str(tmp_path))
+            )
+            assert isinstance(start, cm.DataflowStarted), start
+            await asyncio.sleep(0.5)
+            stopped = await asyncio.wait_for(
+                coord.handle_control_request(
+                    cm.StopRequest(dataflow_uuid=start.uuid,
+                                   grace_duration_s=0.3)
+                ),
+                timeout=60,
+            )
+            assert isinstance(stopped, cm.DataflowStopped), stopped
+            errors = dict(stopped.result.errors())
+            assert errors["stubborn"].cause.kind == "grace_duration"
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            task.cancel()
+            await coord.close()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# daemon -> coordinator reconnect (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_reconnects_after_connection_drop():
+    """Force-dropping the coordinator side of a registered daemon's
+    connection triggers re-register with backoff; the machine slot is
+    live again well inside the 30 s heartbeat-drop window."""
+
+    async def main():
+        coord = Coordinator()
+        await coord.start()
+        daemon = Daemon()
+        task = asyncio.create_task(
+            daemon.run(f"127.0.0.1:{coord.daemon_port}", "A")
+        )
+        try:
+            await _wait_machines(coord, {"A"})
+            old = coord.daemons["A"]
+            assert old.connected
+            # Simulate a half-open drop: kill the socket out from under
+            # both sides.
+            old.writer.close()
+
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                handle = coord.daemons.get("A")
+                if handle is not None and handle.connected \
+                        and handle is not old:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "daemon did not re-register"
+                await asyncio.sleep(0.1)
+
+            # The control plane sees the machine as connected again.
+            reply = await coord.handle_control_request(cm.DaemonConnected())
+            assert reply.connected
+        finally:
+            await coord.handle_control_request(cm.Destroy())
+            task.cancel()
+            await coord.close()
+
+    asyncio.run(main())
