@@ -533,6 +533,45 @@ def serving_trace_ab() -> dict:
     return data
 
 
+def serving_spec_ab() -> dict:
+    """Speculative-decoding sweep (tools/bench_serving --spec-ab):
+    tokens per dispatch and draft acceptance at spec_k in {0, 2, 4} x
+    window K in {1, 8}, on the stub engine's repetitive (best-case) and
+    random (worst-case) token rules. Headlines:
+    ``rep_k4_vs_k0_tpd_at_k8`` (the >=1.5x gate — speculation must
+    multiply what the K-window already amortizes) and
+    ``rand_k4_vs_k0_tpd_at_k8`` (the <=10%-regression bound when
+    nothing accepts). Fresh subprocess for the same accelerator-claim
+    reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--spec-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "spec_ab" in row:
+            data = row["spec_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "legs": None,
+            "rep_k4_vs_k0_tpd_at_k8": None,
+            "rand_k4_vs_k0_tpd_at_k8": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -705,6 +744,16 @@ def main() -> int:
         }
 
     try:
+        spec_ab = serving_spec_ab()
+    except Exception as exc:
+        spec_ab = {
+            "legs": None,
+            "rep_k4_vs_k0_tpd_at_k8": None,
+            "rand_k4_vs_k0_tpd_at_k8": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -739,6 +788,7 @@ def main() -> int:
         "serving_engine_ab": engine_ab,
         "serving_multistep_ab": multistep_ab,
         "serving_trace_ab": trace_ab,
+        "serving_spec_ab": spec_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

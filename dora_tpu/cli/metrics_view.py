@@ -154,6 +154,10 @@ def render_metrics(
                 else "-"
             )
             tpd = s.get("tokens_per_dispatch")
+            # Draft acceptance rate (speculative decoding). Old
+            # snapshots predate the field and spec-off engines never
+            # draft: both render as a dash, per the PR-5 convention.
+            acc = s.get("spec_acceptance")
             serving_rows.append([
                 f"{nid} ({s.get('engine', '?')})",
                 f"{s.get('slots_active', 0)}/{s.get('slots_total', 0)}",
@@ -162,6 +166,7 @@ def render_metrics(
                 str(toks),
                 tps,
                 f"{tpd:.1f}" if tpd is not None else "-",
+                f"{acc * 100:.0f}%" if acc is not None else "-",
                 _fmt_us(ttft.get("p50_us")),
                 _fmt_us(ttft.get("p99_us")),
                 _fmt_us(gap.get("p50_us")),
@@ -172,8 +177,8 @@ def render_metrics(
             ])
         lines += [""] + _table(
             ["SERVING", "SLOTS", "PAGES", "BACKLOG", "TOKENS", "TOK/S",
-             "TOK/DISP", "TTFT P50", "TTFT P99", "GAP P50", "GAP P99",
-             "FETCH P50", "COMPILES", "REQS"],
+             "TOK/DISP", "ACC%", "TTFT P50", "TTFT P99", "GAP P50",
+             "GAP P99", "FETCH P50", "COMPILES", "REQS"],
             serving_rows,
         )
         # Page-occupancy sparkline: used/total over the watch history

@@ -182,6 +182,7 @@ class ServingMetrics:
         "host_fetches", "compiles", "engine",
         "checkpoints", "last_checkpoint_unix", "restored_streams",
         "migrated_out", "migrated_in",
+        "spec_drafted", "spec_accepted", "spec_accept_len",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -239,6 +240,16 @@ class ServingMetrics:
         #: live streams drained to / admitted from a migration handoff
         self.migrated_out = 0
         self.migrated_in = 0
+        #: prompt-lookup speculation (paged engine, DORA_SPEC_K):
+        #: drafts proposed vs drafts the verification pass accepted —
+        #: the acceptance rate is the lever behind tokens_per_dispatch
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        #: tokens emitted per verification pass (accepted + the bonus
+        #: token, 1..spec_k+1) as a log2 histogram — the accepted-length
+        #: distribution, reusing the octave buckets (values are token
+        #: counts here, not µs)
+        self.spec_accept_len = Histogram()
 
     def snapshot(self) -> dict:
         import time
@@ -281,6 +292,14 @@ class ServingMetrics:
             "restored_streams": self.restored_streams,
             "migrated_out": self.migrated_out,
             "migrated_in": self.migrated_in,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_acceptance": (
+                round(self.spec_accepted / self.spec_drafted, 4)
+                if self.spec_drafted
+                else None
+            ),
+            "spec_accept_len": self.spec_accept_len.snapshot(),
         }
 
 

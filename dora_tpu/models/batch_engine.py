@@ -377,12 +377,22 @@ class PagedBatchEngine:
       * ``window_step(tokens [B], pools, positions [B], bts [B, P],
         active [B], emitted [B], max_new [B])`` ->
         (mat [B, K+1], tokens, positions, active, emitted, pools)
+
+    With ``spec_k > 0`` (prompt-lookup speculation,
+    models/vlm.make_paged_spec_window) the window signature instead
+    takes and returns two extra per-stream device buffers —
+    ``history [B, hist_buf]`` and ``hist_len [B]`` — and ``mat`` is the
+    ragged ``[B, K*(spec_k+1) + 1]`` emission matrix; each dispatch can
+    then emit up to K*(spec_k+1) tokens per stream. Emitted tokens are
+    identical to ``spec_k = 0`` at every (K, k): drafts are verified by
+    the same greedy model pass, and the host unpack replays the
+    device's acceptance walk token by token.
     """
 
     def __init__(self, *, init_pool, chunk_prefill, window_step,
                  max_slots: int = 16, max_seq: int, page_size: int,
                  chunk: int, num_pages: int, eos: int | None = None,
-                 window: int = 8):
+                 window: int = 8, spec_k: int = 0, spec_ngram: int = 2):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -391,6 +401,7 @@ class PagedBatchEngine:
         assert chunk % page_size == 0, (chunk, page_size)
         assert max_seq % chunk == 0, (max_seq, chunk)
         assert window >= 1, window
+        assert spec_k >= 0, spec_k
         self._jnp = jnp
         self._np = np
         self.max_slots = max_slots
@@ -427,6 +438,20 @@ class PagedBatchEngine:
         self._emitted_dev = jnp.zeros((max_slots,), jnp.int32)
         self._maxnew_dev = jnp.zeros((max_slots,), jnp.int32)
         self._members_dirty = True
+        #: prompt-lookup speculation (0 = off = the exact pre-spec
+        #: program). With spec_k > 0 the window is the
+        #: make_paged_spec_window variant and carries two extra device
+        #: buffers: per-stream token history and its lengths, mirrored
+        #: host-side (_hist) so membership rebuilds, checkpoints and
+        #: migration stay plain-python — the mirror IS the stream's
+        #: prompt + emissions, which the host already knows.
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
+        if spec_k:
+            self._hist_buf = max_seq + spec_k + 1
+            self._hist: list[list[int]] = [[] for _ in range(max_slots)]
+            self._hist_dev = jnp.zeros((max_slots, self._hist_buf), jnp.int32)
+            self._histlen_dev = jnp.zeros((max_slots,), jnp.int32)
         #: prefill chunks run (serving metrics)
         self.chunks_run = 0
         #: host->device program launches / device->host token fetches
@@ -476,22 +501,32 @@ class PagedBatchEngine:
     def free_pages(self) -> int:
         return self.allocator.free_pages
 
+    def spec_headroom(self) -> int:
+        """Extra rows a speculative verification pass may touch past
+        ``prompt + max_new``: the last verify launches at position
+        ``prompt + max_new - 1`` and writes ``spec_k + 1`` rows, so the
+        admission math must reserve sequence room AND pages for the
+        tail — the serial gate's contract (spec_decode.check_headroom),
+        now in page units. 0 with speculation off, keeping the
+        admission math byte-identical to the pre-spec engine."""
+        return self.spec_k + 1 if self.spec_k else 0
+
     def fits(self, prompt_len: int, max_new: int) -> bool:
         """Admissible EVER: length fits the block table and the whole
         pool could grant its pages (a request that can never fit must
         be rejected up front, not parked in a backlog forever)."""
         return (
-            prompt_len + max_new <= self.max_seq
+            prompt_len + max_new + self.spec_headroom() <= self.max_seq
             and self.pages_needed(prompt_len, max_new)
             <= self.allocator.num_pages - 1
         )
 
     def pages_needed(self, prompt_len: int, max_new: int) -> int:
         """Pages a stream can touch end to end: chunk-padded prefill
-        writes (whole pages) vs prompt + max_new decode rows, whichever
-        reaches further."""
+        writes (whole pages) vs prompt + max_new decode rows (+ the
+        speculative verification tail), whichever reaches further."""
         chunk_rows = -(-prompt_len // self.chunk) * self.chunk
-        rows = max(chunk_rows, prompt_len + max_new)
+        rows = max(chunk_rows, prompt_len + max_new + self.spec_headroom())
         return -(-rows // self.page_size)
 
     def can_admit(self, prompt_len: int, max_new: int) -> bool:
@@ -525,6 +560,8 @@ class PagedBatchEngine:
         self._decode[b] = False
         self._prefillq.append(b)
         self._bt_dirty = True
+        if self.spec_k:
+            self._hist[b] = list(ids)  # draft lookup sees the prompt too
         if self.serving_metrics is not None:
             g = self.serving_metrics.grant_pages
             g[len(pages)] = g.get(len(pages), 0) + 1
@@ -542,6 +579,8 @@ class PagedBatchEngine:
         self._decode[b] = False
         self._bt_dirty = True
         self._members_dirty = True
+        if self.spec_k:
+            self._hist[b] = []
 
     # -- the interleaved step ------------------------------------------------
 
@@ -598,6 +637,8 @@ class PagedBatchEngine:
                     self._free_slot(b)
                 else:
                     self._decode[b] = True
+                    if self.spec_k:
+                        self._hist[b].append(token)
                     self.tokens, self.positions = self._set_slot(
                         self.tokens, self.positions,
                         jnp.asarray(token, jnp.int32),
@@ -638,6 +679,23 @@ class PagedBatchEngine:
                     ],
                     jnp.int32,
                 )
+                if self.spec_k:
+                    # History only needs rebuilding when membership
+                    # changes too: between boundaries the device carries
+                    # it forward and the host mirror appends the same
+                    # tokens the unpack loop emits.
+                    hist = np.zeros(
+                        (self.max_slots, self._hist_buf), np.int32
+                    )
+                    hlen = np.zeros((self.max_slots,), np.int32)
+                    for i, s in enumerate(self.slots):
+                        if s is None or not self._decode[i]:
+                            continue
+                        row = self._hist[i][: self._hist_buf]
+                        hist[i, : len(row)] = row
+                        hlen[i] = len(row)
+                    self._hist_dev = jnp.asarray(hist)
+                    self._histlen_dev = jnp.asarray(hlen)
                 self._members_dirty = False
             if self._bt_dirty:
                 self._bt_dec = jnp.asarray(
@@ -645,17 +703,33 @@ class PagedBatchEngine:
                 )
                 self._bt_dirty = False
             t_win = time.perf_counter()
-            (
-                mat,
-                self.tokens,
-                self.positions,
-                self._mask,
-                self._emitted_dev,
-                self.pools,
-            ) = self.window_step(
-                self.tokens, self.pools, self.positions, self._bt_dec,
-                self._mask, self._emitted_dev, self._maxnew_dev,
-            )
+            if self.spec_k:
+                (
+                    mat,
+                    self.tokens,
+                    self.positions,
+                    self._mask,
+                    self._emitted_dev,
+                    self.pools,
+                    self._hist_dev,
+                    self._histlen_dev,
+                ) = self.window_step(
+                    self.tokens, self.pools, self.positions, self._bt_dec,
+                    self._mask, self._emitted_dev, self._maxnew_dev,
+                    self._hist_dev, self._histlen_dev,
+                )
+            else:
+                (
+                    mat,
+                    self.tokens,
+                    self.positions,
+                    self._mask,
+                    self._emitted_dev,
+                    self.pools,
+                ) = self.window_step(
+                    self.tokens, self.pools, self.positions, self._bt_dec,
+                    self._mask, self._emitted_dev, self._maxnew_dev,
+                )
             self.dispatches += 1
             t_fetch = time.perf_counter()
             host = np.asarray(mat)  # ONE [B, K+1] device->host transfer
@@ -667,44 +741,97 @@ class PagedBatchEngine:
                 # Span per decoding stream BEFORE the unpack loop frees
                 # finished slots; all rows share the window's host span
                 # (one dispatch serves them all).
-                from dora_tpu.models.vlm import window_row_stats
+                from dora_tpu.models.vlm import (
+                    spec_window_row_stats, window_row_stats,
+                )
 
                 win_ns = int((t_done - t_win) * 1e9)
                 for b, slot in enumerate(self.slots):
                     if slot is None or not self._decode[b]:
                         continue
-                    n_emit, frozen = window_row_stats(host[b], self.window)
+                    if self.spec_k:
+                        n_emit, frozen = spec_window_row_stats(
+                            host[b], self.window, self.spec_k + 1
+                        )
+                    else:
+                        n_emit, frozen = window_row_stats(
+                            host[b], self.window
+                        )
                     self.tracer.span(
                         "s_decode_window", slot.request_id,
                         f"K={self.window} emitted={n_emit} "
                         f"frozen_at={frozen}",
                         dur_ns=win_ns,
                     )
-            for b, slot in enumerate(self.slots):
-                if slot is None or not self._decode[b]:
-                    continue
-                # Unpack this row up to its done offset: the host
-                # completion test mirrors the device's exactly (same
-                # emitted counter, same cap, same eos), so the first
-                # host-done token is precisely where the device froze
-                # the row; later columns hold the -1 sentinel.
-                for j in range(self.window):
-                    token = int(host[b, j])
+            if self.spec_k:
+                self._unpack_spec(host, emitted, sm)
+            else:
+                for b, slot in enumerate(self.slots):
+                    if slot is None or not self._decode[b]:
+                        continue
+                    # Unpack this row up to its done offset: the host
+                    # completion test mirrors the device's exactly (same
+                    # emitted counter, same cap, same eos), so the first
+                    # host-done token is precisely where the device froze
+                    # the row; later columns hold the -1 sentinel.
+                    for j in range(self.window):
+                        token = int(host[b, j])
+                        if token < 0:
+                            break
+                        slot.emitted += 1
+                        done = (
+                            slot.emitted >= slot.max_new
+                            or (self.eos is not None and token == self.eos)
+                        )
+                        emitted.append((slot.request_id, token, done))
+                        if done:
+                            self._free_slot(b)
+                            break
+        if first_emit is not None:
+            key, t_first = first_emit
+            self.emit_lag_s[key] = time.perf_counter() - t_first
+        return emitted
+
+    def _unpack_spec(self, host, emitted, sm) -> None:
+        """Unpack the spec window's ragged ``[B, K*(spec_k+1) + 1]``
+        matrix by replaying the device's acceptance/completion walk: a
+        ``-1`` inside a tick-block only pads past that tick's accepted
+        length (the stream may emit again next tick), so the walk
+        advances tick by tick and stops a stream only where the host's
+        own completion test fires — which is, by construction, exactly
+        where the device froze it. Also feeds the host history mirror
+        and the draft acceptance metrics (drafted = spec_k per live
+        verification pass; accepted = emissions minus the bonus
+        token)."""
+        m = self.spec_k + 1
+        for b, slot in enumerate(self.slots):
+            if slot is None or not self._decode[b]:
+                continue
+            stream_done = False
+            for t in range(self.window):
+                got = 0
+                for i in range(m):
+                    token = int(host[b, t * m + i])
                     if token < 0:
                         break
+                    got += 1
                     slot.emitted += 1
+                    self._hist[b].append(token)
                     done = (
                         slot.emitted >= slot.max_new
                         or (self.eos is not None and token == self.eos)
                     )
                     emitted.append((slot.request_id, token, done))
                     if done:
-                        self._free_slot(b)
+                        stream_done = True
                         break
-        if first_emit is not None:
-            key, t_first = first_emit
-            self.emit_lag_s[key] = time.perf_counter() - t_first
-        return emitted
+                if sm is not None and got:
+                    sm.spec_drafted += self.spec_k
+                    sm.spec_accepted += got - 1
+                    sm.spec_accept_len.observe(got)
+                if stream_done:
+                    self._free_slot(b)
+                    break
 
     # -- checkpoint / restore / migration ------------------------------------
 
@@ -721,21 +848,27 @@ class PagedBatchEngine:
         for b, s in enumerate(self.slots):
             if s is None:
                 continue
-            slots.append(
-                {
-                    "slot": b,
-                    "request_id": s.request_id,
-                    "emitted": s.emitted,
-                    "max_new": s.max_new,
-                    "pages": [int(p) for p in s.pages],
-                    "prompt": list(s.prompt) if s.prompt is not None else None,
-                    "true_len": s.true_len,
-                    "chunk_base": s.chunk_base,
-                    "decode": bool(self._decode[b]),
-                    "last_token": int(toks[b]),
-                    "position": int(pos[b]),
-                }
-            )
+            meta = {
+                "slot": b,
+                "request_id": s.request_id,
+                "emitted": s.emitted,
+                "max_new": s.max_new,
+                "pages": [int(p) for p in s.pages],
+                "prompt": list(s.prompt) if s.prompt is not None else None,
+                "true_len": s.true_len,
+                "chunk_base": s.chunk_base,
+                "decode": bool(self._decode[b]),
+                "last_token": int(toks[b]),
+                "position": int(pos[b]),
+            }
+            if self.spec_k:
+                # Draft-lookup history (prompt + emissions). Output
+                # identity does NOT depend on it — verification makes
+                # the emitted tokens exact whatever the drafts — but
+                # restoring it keeps post-resume acceptance rates (and
+                # so dispatch counts) identical too.
+                meta["history"] = [int(t) for t in self._hist[b]]
+            slots.append(meta)
         return {"slots": slots}
 
     def restore_state(self, state: dict, *, pin_slots: bool = True) -> list[str]:
@@ -793,6 +926,15 @@ class PagedBatchEngine:
                 chunk_base=meta["chunk_base"],
             )
             self._decode[b] = True
+            if self.spec_k:
+                # A snapshot from a spec-off engine (or an older build)
+                # carries no history: seed with the last token — the
+                # lookup's fallback draft — which keeps resumes legal
+                # and still token-exact, just with cold acceptance.
+                self._hist[b] = [
+                    int(t)
+                    for t in meta.get("history") or [meta["last_token"]]
+                ]
             self.tokens, self.positions = self._set_slot(
                 self.tokens,
                 self.positions,
@@ -841,7 +983,9 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
                            page_size: int = 8, chunk: int = 16,
                            num_pages: int | None = None,
                            eos: int | None = None, window: int = 1,
-                           vocab: int = 97, tick_sleep_s: float = 0.0):
+                           vocab: int = 97, tick_sleep_s: float = 0.0,
+                           spec_k: int = 0, spec_ngram: int = 2,
+                           cycle: int | None = None):
     """A weight-free :class:`PagedBatchEngine` over the REAL window
     machinery: the decode window is ``vlm.make_paged_window`` (the same
     ``lax.scan`` + ``freeze_inactive`` program serving runs) with the
@@ -857,20 +1001,52 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
     checkpoint is available. ``tick_sleep_s`` adds a host sleep of
     ``tick_sleep_s * window`` per decode window (after device sync) to
     emulate per-tick device cost — the TTFT-quantization regression
-    test needs windows that measurably take K ticks."""
+    test needs windows that measurably take K ticks.
+
+    ``spec_k > 0`` swaps in ``vlm.make_paged_spec_window`` (prompt-
+    lookup speculation, the production serving path's window) with the
+    stub rule doubling as the verifier: the rule is memoryless, so
+    verifying candidate ``c`` is just ``rule(c)``, and emitted streams
+    stay identical to the spec-off stub at every (K, k). ``cycle``
+    selects the deterministic REPETITIVE rule ``next = (t + 1) % cycle``
+    instead of the affine one: its period-``cycle`` token loop is
+    exactly what trailing-ngram lookup predicts, so acceptance goes to
+    ~100% after one period — while the affine rule (period ~vocab)
+    keeps acceptance near zero. Together they drive both the
+    draft-accept and draft-reject paths engine-free (the
+    ``DORA_STUB_ENGINE=1`` A/B legs of bench_serving --spec-ab)."""
     import jax
     import jax.numpy as jnp
 
-    from dora_tpu.models.vlm import make_paged_window
+    from dora_tpu.models.vlm import make_paged_spec_window, make_paged_window
 
     if num_pages is None:
         num_pages = max_slots * (max_seq // page_size) + 1
 
+    if cycle is None:
+        def rule(t):
+            return (t * 7 + 3) % vocab
+    else:
+        def rule(t):
+            return (t + 1) % cycle
+
     def step_fn(tokens, pools, positions, bts):
         del positions, bts
-        return (tokens * 7 + 3) % vocab, pools
+        return rule(tokens), pools
 
-    base_window = jax.jit(make_paged_window(step_fn, k=window, eos=eos))
+    if spec_k:
+        def spec_step_fn(chunks, pools, positions, bts):
+            del positions, bts
+            return rule(chunks), pools
+
+        base_window = jax.jit(
+            make_paged_spec_window(
+                spec_step_fn, k=window, spec_k=spec_k, ngram=spec_ngram,
+                eos=eos,
+            )
+        )
+    else:
+        base_window = jax.jit(make_paged_window(step_fn, k=window, eos=eos))
 
     def window_step(*args):
         out = base_window(*args)
@@ -880,7 +1056,7 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         return out
 
     chunk_fn = jax.jit(
-        lambda ids, pools, position, bt: ((ids * 7 + 3) % vocab, pools)
+        lambda ids, pools, position, bt: (rule(ids), pools)
     )
 
     return PagedBatchEngine(
@@ -894,4 +1070,6 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         num_pages=num_pages,
         eos=eos,
         window=window,
+        spec_k=spec_k,
+        spec_ngram=spec_ngram,
     )

@@ -268,6 +268,34 @@ def fused_paged_batch_step(params, cfg, tokens, pools, positions,
     )
 
 
+def fused_paged_spec_step(params, cfg, chunks, pools, positions,
+                          block_tables):
+    """Speculative VERIFICATION pass for B independent streams over
+    PAGED KV pools: chunks [B, m] holds each stream's (last token +
+    m-1 drafts) at positions ``positions[b]..positions[b]+m-1``;
+    greedy[b, i] continues stream b's prefix through candidate i, so
+    the caller's acceptance test over (greedy, drafts) replays the
+    serial spec_decode contract exactly. Returns (greedy [B, m],
+    pools). The spec window's inner step
+    (models/vlm.make_paged_spec_window)."""
+    from dora_tpu.models import vlm as _vlm
+    from dora_tpu.ops import decode_block as DB
+
+    dtype = L.compute_dtype()
+    b, m = chunks.shape
+    cos_t, sin_t = L.rope_table(cfg.max_seq, cfg.head_dim,
+                                base=cfg.rope_theta)
+    flat_pos = (positions[:, None] + jnp.arange(m)[None, :]).reshape(b * m)
+    cos_rows, sin_rows = DB.rope_rows_at(cos_t, sin_t, flat_pos)
+    x = params["embed"].astype(dtype)[chunks.reshape(b * m)]  # [B*m, dim]
+    greedy, pools = _vlm.fused_paged_pass_spec(
+        params, x, pools, positions, block_tables, cos_rows, sin_rows,
+        heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+        layers=cfg.layers, m=m, eps=cfg.norm_eps,
+    )
+    return greedy.reshape(b, m), pools
+
+
 def fused_paged_chunk_step(params, cfg, chunk_ids, pools, position,
                            block_table):
     """One prefill chunk into paged pools: chunk_ids [C] int32 at
@@ -317,7 +345,9 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
                       eos: int | None = None, page_size: int = 16,
                       chunk: int | None = None,
                       num_pages: int | None = None,
-                      window: int | None = None):
+                      window: int | None = None,
+                      spec_k: int | None = None,
+                      spec_ngram: int | None = None):
     """Paged-KV continuous-batching engine (requires the quantized fused
     layout, like :func:`make_batch_engine`). Defaults size the pool to
     EXACTLY the dense engine's 4-slot HBM footprint (4 * max_seq KV
@@ -331,7 +361,17 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
     and fetches one [B, K+1] token matrix, amortizing host dispatch and
     device->host fetch cost across K tokens. ``window=1`` is the
     per-token dispatch behavior of the pre-window engine, same greedy
-    tokens either way (asserted in tests/test_paged_engine.py)."""
+    tokens either way (asserted in tests/test_paged_engine.py).
+
+    ``spec_k`` (default: env ``DORA_SPEC_K``, else 0 = off) folds
+    prompt-lookup speculation INTO each window tick
+    (models/vlm.make_paged_spec_window): per tick every stream drafts
+    ``spec_k`` tokens by trailing-ngram lookup (``spec_ngram``, env
+    ``DORA_SPEC_NGRAM``, default 2) and one batched verification pass
+    checks them all — up to ``window * (spec_k + 1)`` tokens per
+    dispatch, token-identical to ``spec_k = 0`` (verification replays
+    the serial spec_decode acceptance test). ``spec_k = 0`` builds
+    today's window program, byte-identical."""
     import os
 
     from dora_tpu.models import vlm as _vlm
@@ -346,16 +386,34 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         num_pages = 4 * cfg.max_seq // page_size
     if window is None:
         window = int(os.environ.get("DORA_MULTISTEP_K", "8"))
-    window_fn = jax.jit(
-        _vlm.make_paged_window(
-            lambda tokens, pools, positions, bts: fused_paged_batch_step(
-                params, cfg, tokens, pools, positions, bts
+    if spec_k is None:
+        spec_k = int(os.environ.get("DORA_SPEC_K", "0"))
+    if spec_ngram is None:
+        spec_ngram = int(os.environ.get("DORA_SPEC_NGRAM", "2"))
+    if spec_k:
+        window_fn = jax.jit(
+            _vlm.make_paged_spec_window(
+                lambda chunks, pools, positions, bts: fused_paged_spec_step(
+                    params, cfg, chunks, pools, positions, bts
+                ),
+                k=window,
+                spec_k=spec_k,
+                ngram=spec_ngram,
+                eos=eos,
             ),
-            k=window,
-            eos=eos,
-        ),
-        donate_argnums=(1,),
-    )
+            donate_argnums=(1,),
+        )
+    else:
+        window_fn = jax.jit(
+            _vlm.make_paged_window(
+                lambda tokens, pools, positions, bts: fused_paged_batch_step(
+                    params, cfg, tokens, pools, positions, bts
+                ),
+                k=window,
+                eos=eos,
+            ),
+            donate_argnums=(1,),
+        )
     chunk_fn = jax.jit(
         lambda ids, pools, position, bt: fused_paged_chunk_step(
             params, cfg, ids, pools, position, bt
@@ -373,6 +431,8 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         chunk=chunk,
         num_pages=num_pages,
         eos=eos,
+        spec_k=spec_k,
+        spec_ngram=spec_ngram,
     )
 
 

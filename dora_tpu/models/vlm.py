@@ -544,6 +544,36 @@ def fused_paged_pass_chunk(params, x, pools, position, block_table,
     )
 
 
+def fused_paged_pass_spec(params, x, pools, positions, block_tables,
+                          cos_rows, sin_rows, *, heads: int, kv_heads: int,
+                          head_dim: int, layers: int, m: int,
+                          eps: float = 1e-6):
+    """Speculative VERIFICATION pass over paged KV pools: x [B*m, dim]
+    holds, stream-major, each stream's m = k+1 candidate rows (last
+    emitted token + its k drafts) at positions
+    ``positions[b]..positions[b]+m-1`` of that stream's paged context
+    (ops.decode_block.attention_paged_spec_step). One weight stream
+    verifies all B·m rows; greedy[b*m + i] continues stream b's prefix
+    through candidate i, so comparing it against the drafts replays
+    exactly the serial spec_decode acceptance test. Returns
+    (greedy [B*m], pools)."""
+    from dora_tpu.ops import decode_block as DB
+
+    def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        x, kp, vp = DB.attention_paged_spec_step(
+            x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+            pools[str(i)]["k"], pools[str(i)]["v"], wo, swo, positions,
+            block_tables,
+            heads=heads, kv_heads=kv_heads, head_dim=head_dim, m=m, eps=eps,
+        )
+        return x, {"k": kp, "v": vp}
+
+    return _fused_pass(
+        params, x, attn_apply, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, layers=layers, eps=eps,
+    )
+
+
 def make_paged_window(step_fn, *, k: int, eos: int | None = None):
     """Fused K-step decode window over a paged batch step.
 
@@ -605,6 +635,128 @@ def make_paged_window(step_fn, *, k: int, eos: int | None = None):
     return window
 
 
+def make_paged_spec_window(spec_step_fn, *, k: int, spec_k: int,
+                           ngram: int, eos: int | None = None):
+    """Fused K-step decode window with prompt-lookup SPECULATION folded
+    into every tick: one dispatch can emit up to ``k * (spec_k + 1)``
+    tokens per stream instead of ``k``.
+
+    Each of the ``k`` scanned ticks, per stream and entirely on device:
+    draft ``spec_k`` tokens by trailing-ngram lookup against that
+    stream's history buffer (models/spec_decode.lookup, vmapped over
+    slots), verify the (last token + drafts) chunk in ONE batched
+    chunk pass through ``spec_step_fn``, accept the longest agreeing
+    prefix plus the bonus token (the serial ``run_loop`` test,
+    verbatim), then append the emissions to the history carry and
+    advance the stream's position by the accepted length — so rejected
+    tail rows in the paged KV are overwritten by the next chunk before
+    any sweep can attend them (the spec_decode invariant). Mid-chunk
+    completion is honoured exactly like the base window's mid-window
+    completion: an EOS or ``max_new`` hit at candidate i truncates the
+    tick's emission at i and freezes the stream
+    (:func:`ops.decode_block.freeze_inactive` null-page routing,
+    unchanged).
+
+    ``spec_step_fn(chunks [B, spec_k+1], pools, positions, bts) ->
+    (greedy [B, spec_k+1], pools)`` is the family's batched paged
+    verification closure (e.g. ``qwen2.fused_paged_spec_step``
+    partially applied).
+
+    Emission is RAGGED: the host gets one ``[B, k*(spec_k+1) + 1]``
+    int32 matrix — k tick-blocks of spec_k+1 token columns, ``-1``
+    sentinels padding each tick past its accepted length (and filling
+    whole blocks for frozen streams), plus the final active mask as
+    the last column. The host unpacks it by replaying the same
+    acceptance/completion walk (the PR-5 device/host contract), so
+    device and host can never disagree on what was emitted.
+
+    Returns ``window(tokens, pools, positions, bts, active, emitted,
+    max_new, history, hist_len) -> (mat, tokens, positions, active,
+    emitted, pools, history, hist_len)`` — two extra carried device
+    buffers vs the base window: per-stream token history
+    ``[B, hist_buf]`` and its lengths ``[B]``, which the engine
+    rebuilds from its host mirror only when slot membership changes.
+    """
+    from dora_tpu.models import spec_decode
+    from dora_tpu.ops import decode_block as DB
+
+    m = spec_k + 1
+
+    def window(tokens, pools, positions, bts, active, emitted, max_new,
+               history, hist_len):
+        hbuf = history.shape[1]
+        nslots = tokens.shape[0]
+
+        def tick(carry, _):
+            (tokens, pools, positions, active, emitted, history,
+             hist_len) = carry
+            alive = active.astype(jnp.int32)
+            pos_in, bts_in = DB.freeze_inactive(positions, bts, active)
+            draft = jax.vmap(
+                lambda h, hl: spec_decode.lookup(h, hl, hbuf, spec_k, ngram)
+            )(history, hist_len)  # [B, spec_k]
+            chunks = jnp.concatenate([tokens[:, None], draft], axis=1)
+            greedy, pools = spec_step_fn(chunks, pools, pos_in, bts_in)
+            # The serial acceptance test (spec_decode.run_loop),
+            # vectorised: longest agreeing draft prefix + bonus token.
+            agree = greedy[:, :spec_k] == draft
+            accepted = jnp.argmin(
+                jnp.concatenate(
+                    [agree, jnp.zeros((nslots, 1), bool)], axis=1
+                ).astype(jnp.int32), axis=1,
+            )
+            n_emit = accepted + 1  # [B] — always >= 1 (bonus token)
+            # Mid-chunk completion: candidate i is the
+            # (emitted+i+1)-th token; the first accepted candidate
+            # that hits EOS or max_new truncates the emission AT that
+            # token and freezes the stream.
+            idx = jnp.arange(m)[None, :]
+            in_acc = idx < n_emit[:, None]
+            stop = (emitted[:, None] + idx + 1) >= max_new[:, None]
+            if eos is not None:
+                stop = stop | (greedy == eos)
+            stop = stop & in_acc
+            has_stop = jnp.any(stop, axis=1)
+            first_stop = jnp.argmax(stop.astype(jnp.int32), axis=1)
+            e = jnp.where(has_stop, first_stop + 1, n_emit) * alive
+            out = jnp.where((idx < e[:, None]) & active[:, None], greedy, -1)
+            last = jnp.take_along_axis(
+                greedy, jnp.maximum(e - 1, 0)[:, None], axis=1
+            )[:, 0]
+            # A frozen row keeps its last real token (base-window
+            # contract); e is already 0 there so positions / emitted /
+            # history stay pinned too.
+            tokens = jnp.where(active, last, tokens)
+            positions = pos_in + e
+            emitted = emitted + e
+            active = active & ~has_stop
+
+            def commit(h, hl, cand, ee):
+                w = jax.lax.dynamic_slice(h, (hl,), (m,))
+                w = jnp.where(jnp.arange(m) < ee, cand, w)
+                return jax.lax.dynamic_update_slice(h, w, (hl,))
+
+            history = jax.vmap(commit)(history, hist_len, greedy, e)
+            hist_len = hist_len + e
+            return (tokens, pools, positions, active, emitted, history,
+                    hist_len), out
+
+        (tokens, pools, positions, active, emitted, history,
+         hist_len), toks = jax.lax.scan(
+            tick,
+            (tokens, pools, positions, active, emitted, history, hist_len),
+            None, length=k,
+        )
+        flat = toks.transpose(1, 0, 2).reshape(nslots, k * m)
+        mat = jnp.concatenate(
+            [flat, active.astype(jnp.int32)[:, None]], axis=1
+        )
+        return (mat, tokens, positions, active, emitted, pools, history,
+                hist_len)
+
+    return window
+
+
 def window_row_stats(row, k: int) -> tuple[int, int | None]:
     """Decode one stream's row of the window's ``[B, k+1]`` token matrix
     into ``(emitted, frozen_at)``: how many real tokens the row emitted
@@ -619,6 +771,31 @@ def window_row_stats(row, k: int) -> tuple[int, int | None]:
             return emitted, j
         emitted += 1
     return emitted, (None if int(row[k]) else k)
+
+
+def spec_window_row_stats(row, k: int, m: int) -> tuple[int, int | None]:
+    """Ragged counterpart of :func:`window_row_stats` for the spec
+    window's ``[B, k*m + 1]`` matrix (m = spec_k + 1): returns
+    ``(emitted, frozen_at)`` where ``emitted`` counts the row's real
+    tokens across all k tick-blocks and ``frozen_at`` is the tick on
+    which the device froze the stream (None if still active after the
+    window). Within a tick-block a ``-1`` only pads past the accepted
+    length — the stream may well emit again next tick — so freezing is
+    read from the final active flag, not from the first sentinel."""
+    emitted = 0
+    last_live = None
+    for t in range(k):
+        got = 0
+        for i in range(m):
+            if int(row[t * m + i]) < 0:
+                break
+            got += 1
+        if got:
+            last_live = t
+        emitted += got
+    if int(row[k * m]):
+        return emitted, None
+    return emitted, (last_live if last_live is not None else 0)
 
 
 def generate_tp(params, tp_params, cfg: VLMConfig, images, prompt_ids,

@@ -39,7 +39,10 @@ DORA_MAX_NEW_TOKENS (default 32) per-request cap (a request's
 (default 16) KV rows per page; DORA_PREFILL_CHUNK prefill chunk rows
 (default min(256, max_seq)); DORA_MULTISTEP_K (default 8) fused decode
 ticks per dispatch (1 = per-token dispatch); DORA_PAGED_KV=0 for the
-dense engine (always per-token).
+dense engine (always per-token); DORA_SPEC_K (default 0 = off) drafts
+k tokens per tick via prompt-lookup and verifies them in the same
+dispatch — up to K·(k+1) tokens per round trip, greedy-exact — with
+DORA_SPEC_NGRAM (default 2) the lookup ngram width.
 
 Serving metrics (slots, free pages, backlog, decode tokens/s, TTFT
 histogram) ship to the daemon every second and surface in
@@ -570,13 +573,55 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             # begin() with the source's serialized context keeps the
             # trace id — ONE contiguous trace spans both engines.
             tracer.begin(nk, src_ctxs.get(old) or "")
-        engine.admit_streams(state)
+        try:
+            engine.admit_streams(state)
+        except RuntimeError:
+            # Capacity raced away between the peek-time fits check and
+            # the claim (local admissions landed first). restore_state
+            # is not transactional — roll back whatever it admitted,
+            # then close EVERY handoff stream with a retriable "error"
+            # finish. The pre-fix failure mode dropped the streams with
+            # no signal to the client at all (round-7 known issue).
+            fresh_keys = set(mapping.values())
+            for b, s in enumerate(engine.slots):
+                if s is not None and s.request_id in fresh_keys:
+                    if b in engine._prefillq:
+                        engine._prefillq.remove(b)
+                    engine._free_slot(b)
+            for nk in mapping.values():
+                metrics.rejected += 1
+                tracer.instant("s_reject", nk, f"migrate-in overflow {src}")
+                emit_text(nk, "", True, finish="error")
+            return
         for nk, ids, mn in parked:
             backlog.push(nk, ids, mn)
         dur = int((clock() - t0) * 1e9)
         for nk in mapping.values():
             tracer.span("s_migrate_in", nk, f"from={src}", dur_ns=dur)
         metrics.migrated_in += len(mapping)
+
+    def _handoff_fits(payload: dict) -> bool:
+        """Can the target admit EVERY stream in the handoff right now?
+        Decode streams re-take exactly the pages the source granted;
+        mid-prefill streams re-submit through the normal admission
+        math (chunk padding + speculative headroom included)."""
+        metas = (payload.get("engine") or {}).get("slots") or []
+        if len(metas) > engine.free_slots:
+            return False
+        pages = 0
+        for m in metas:
+            if m.get("decode"):
+                n = len(m.get("pages") or ())
+                if n * engine.page_size > engine.max_seq:
+                    return False  # block table too short for the stream
+                pages += n
+            else:
+                plen = len(m.get("prompt") or ())
+                mn = int(m.get("max_new", 0))
+                if not engine.fits(plen, mn):
+                    return False
+                pages += engine.pages_needed(plen, mn)
+        return pages <= engine.free_pages
 
     def poll_migrate_in() -> None:
         try:
@@ -588,15 +633,28 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                     and fname.endswith(".json")):
                 continue
             path = os.path.join(migrate_dir, fname)
+            # Peek BEFORE claiming: an undersized target leaves the
+            # handoff on disk — for a bigger peer polling the same dir,
+            # or for a later poll once its own streams drain — instead
+            # of claiming streams it cannot admit. Handoff files are
+            # written once (tmp + rename), so the peeked content is the
+            # claimed content.
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not _handoff_fits(payload):
+                tracer.instant(
+                    "s_migrate_defer", fname,
+                    f"free_slots={engine.free_slots} "
+                    f"free_pages={engine.free_pages}",
+                )
+                continue
             claimed = path + ".claimed"
             try:
                 os.rename(path, claimed)  # atomic claim
             except OSError:
-                continue
-            try:
-                with open(claimed) as f:
-                    payload = json.load(f)
-            except (OSError, ValueError):
                 continue
             _admit_handoff(payload, fname)
             try:
@@ -684,13 +742,19 @@ def _stub_main() -> None:
     ``models.batch_engine.make_stub_paged_engine`` — what the
     observability e2e test and the serving-trace demo dataflow run when
     no checkpoint is available. Tokens are the stub's deterministic
-    affine chain rendered as ``t<id>`` words, not language."""
+    affine chain rendered as ``t<id>`` words, not language —
+    ``DORA_STUB_CYCLE=N`` swaps in the period-N repeating rule (the
+    speculative-decoding best case; pair with ``DORA_SPEC_K``)."""
     from dora_tpu.metrics import ServingMetrics
     from dora_tpu.models.batch_engine import make_stub_paged_engine
 
+    cycle_env = os.environ.get("DORA_STUB_CYCLE", "")
     engine = make_stub_paged_engine(
         max_slots=int(os.environ.get("DORA_BATCH_SLOTS", "4")),
         window=int(os.environ.get("DORA_MULTISTEP_K", "4")),
+        spec_k=int(os.environ.get("DORA_SPEC_K", "0") or 0),
+        spec_ngram=int(os.environ.get("DORA_SPEC_NGRAM", "2") or 2),
+        cycle=int(cycle_env) if cycle_env else None,
     )
     delay = float(os.environ.get("DORA_STEP_DELAY_S", "0") or 0)
     if delay > 0:

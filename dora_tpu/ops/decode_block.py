@@ -1370,6 +1370,318 @@ def attention_paged_chunk_step(
     )
 
 
+def _attn_paged_spec_kernel(
+    pos_ref,  # SMEM (B,) int32 — per-stream chunk START positions
+    bt_ref,   # SMEM (B, max_pages) int32 — per-stream block tables
+    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+    kp_in, vp_in, wo_ref, swo_ref,
+    out_ref, kp_out, vp_out,
+    kv_win, kblk, vblk, sem, wsem,
+    *, heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
+    batch: int, m: int, win: int, seq: int, residual: bool,
+):
+    """B independent speculative-verify chunks over paged KV: stream b's
+    m rows (rows b*m..(b+1)*m-1 of x) occupy positions
+    pos[b]..pos[b]+m-1 of ITS paged context. Math is the chunk kernel's
+    (prior-context flash sweep + within-chunk causal fold from
+    registers), addressing is the paged batch kernel's (every cache
+    touch routes through the stream's block table). The m-row cache
+    write is the one genuinely new piece: unlike the single-row paged
+    RMW, an aligned window covering m consecutive rows can straddle a
+    page boundary, so the window is read, modified and written back in
+    8-row groups — page size is a multiple of 8 and the groups are
+    8-aligned, so each group lives wholly inside ONE pool page and maps
+    through the block table independently. A frozen stream (pos 0,
+    zeroed table row) dumps all m rows into the reserved null page."""
+    half = head_dim // 2
+    dtype = x_ref.dtype
+    int4 = wqkv_ref.dtype == jnp.uint8
+    group = heads // kv_heads
+    scale = 1.0 / (head_dim ** 0.5)
+    rows = m * group  # per kv head, per stream
+    ngroups = win // 8
+
+    # --- projections (all B*m rows at once: one weight pass) ----------------
+    h = _rms(x_ref, nw_ref, eps).astype(dtype)  # [B*m, D]
+    qkv = _wdot(h, wqkv_ref, sqkv_ref[...], int4=int4) + bqkv_ref[...].astype(
+        jnp.float32
+    )
+    bm = batch * m
+    qf = qkv[:, : heads * head_dim].reshape(bm * heads, head_dim)
+    kf = qkv[:, heads * head_dim : (heads + kv_heads) * head_dim].reshape(
+        bm * kv_heads, head_dim
+    )
+    vf = qkv[:, (heads + kv_heads) * head_dim :].reshape(
+        bm * kv_heads, head_dim
+    )
+
+    cos_r = cos_ref[...].astype(jnp.float32)  # [B*m, hd] per-row tables
+    sin_r = sin_ref[...].astype(jnp.float32)
+
+    def _expand(t, reps):
+        return jnp.broadcast_to(
+            t[:, None, :], (bm, reps, head_dim)
+        ).reshape(bm * reps, head_dim)
+
+    q = _rotate(qf, _expand(cos_r, heads), _expand(sin_r, heads), half)
+    k = _rotate(kf, _expand(cos_r, kv_heads), _expand(sin_r, kv_heads), half)
+    q_s = q.reshape(batch, m, heads, head_dim)
+    k_s = k.reshape(batch, m, kv_heads, head_dim)
+    v_s = vf.reshape(batch, m, kv_heads, head_dim)
+
+    # --- per-stream m-row cache RMW in page-safe 8-row groups ---------------
+    # The aligned window [aligned, aligned+win) covers all m rows (same
+    # clamp as the dense chunk kernel, so it never walks past seq). Rows
+    # the window drags in beyond the chunk — up to 7 before pos and the
+    # alignment tail after pos+m-1 — are read and written back
+    # unchanged, so a tail group resolving to an ungranted table entry
+    # (physical page 0) only round-trips null-page bytes. The flash
+    # sweep below never reads rows >= pos from the pool (``live`` masks
+    # them; the chunk rows fold in from registers), so only the group
+    # READS gate the inserts and the write-backs overlap the sweep.
+    pending = []
+    for b in range(batch):
+        pos = pos_ref[b]
+        aligned = pl.multiple_of(
+            jnp.minimum(pos // 8 * 8, seq - win), 8
+        )
+        reads = []
+        for g in range(ngroups):
+            gs = aligned + g * 8
+            pg = bt_ref[b, gs // page]
+            off = pl.multiple_of(gs - gs // page * page, 8)
+            rd_k = pltpu.make_async_copy(
+                kp_out.at[pg, :, pl.ds(off, 8), :],
+                kv_win.at[0, b, :, pl.ds(g * 8, 8), :], sem.at[0],
+            )
+            rd_v = pltpu.make_async_copy(
+                vp_out.at[pg, :, pl.ds(off, 8), :],
+                kv_win.at[1, b, :, pl.ds(g * 8, 8), :], sem.at[1],
+            )
+            rd_k.start()
+            rd_v.start()
+            reads += [rd_k, rd_v]
+        for rd in reads:
+            rd.wait()
+        offs = pos - aligned
+        win_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (kv_heads, win, head_dim), 1
+        )
+        for i in range(m):
+            sel = win_iota == offs + i
+            kv_win[0, b] = jnp.where(
+                sel, k_s[b, i][:, None, :].astype(kv_win.dtype), kv_win[0, b]
+            )
+            kv_win[1, b] = jnp.where(
+                sel, v_s[b, i][:, None, :].astype(kv_win.dtype), kv_win[1, b]
+            )
+        for g in range(ngroups):
+            gs = aligned + g * 8
+            pg = bt_ref[b, gs // page]
+            off = pl.multiple_of(gs - gs // page * page, 8)
+            wr_k = pltpu.make_async_copy(
+                kv_win.at[0, b, :, pl.ds(g * 8, 8), :],
+                kp_out.at[pg, :, pl.ds(off, 8), :], wsem.at[0, b, g],
+            )
+            wr_v = pltpu.make_async_copy(
+                kv_win.at[1, b, :, pl.ds(g * 8, 8), :],
+                vp_out.at[pg, :, pl.ds(off, 8), :], wsem.at[1, b, g],
+            )
+            wr_k.start()
+            wr_v.start()
+            pending += [wr_k, wr_v]
+
+    # --- per-stream flash sweep + within-chunk causal fold ------------------
+    attn_rows = []
+    for b in range(batch):
+        pos = pos_ref[b]
+        nblocks = (pos + page - 1) // page  # prior context only
+
+        def body(blk, carry, pos=pos, b=b):
+            m_run, l_run, acc = carry
+            pg = bt_ref[b, blk]
+            kcp = pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2])
+            vcp = pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3])
+            kcp.start()
+            vcp.start()
+            kcp.wait()
+            vcp.wait()
+            live = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) + blk * page
+            ) < pos
+            outs = []
+            for g in range(kv_heads):
+                q_g = q_s[b, :, g * group : (g + 1) * group, :].reshape(
+                    rows, head_dim
+                )
+                s_g = jax.lax.dot_general(
+                    q_g.astype(dtype), kblk[g].astype(dtype),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale  # [rows, page]
+                outs.append(jnp.where(live, s_g, -jnp.inf))
+            s = jnp.concatenate(outs, axis=0)  # [KV*rows, page]
+            m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_run - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = []
+            for g in range(kv_heads):
+                pv.append(
+                    jax.lax.dot(
+                        p[g * rows : (g + 1) * rows].astype(dtype),
+                        vblk[g].astype(dtype),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            acc_new = acc * alpha + jnp.concatenate(pv, axis=0)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((kv_heads * rows, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((kv_heads * rows, 1), jnp.float32)
+        a0 = jnp.zeros((kv_heads * rows, head_dim), jnp.float32)
+        m_fin, l_fin, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, a0))
+
+        # within-chunk causal attention from registers — stream-local:
+        # rows of stream b attend ONLY their own chunk, never another
+        # stream's (the sequences are independent).
+        causal = (
+            jax.lax.broadcasted_iota(jnp.int32, (rows, m), 0) // group
+            >= jax.lax.broadcasted_iota(jnp.int32, (rows, m), 1)
+        )
+        s_parts = []
+        for g in range(kv_heads):
+            q_g = q_s[b, :, g * group : (g + 1) * group, :].reshape(
+                rows, head_dim
+            )
+            s_cc = jax.lax.dot_general(
+                q_g.astype(dtype), k_s[b, :, g, :].astype(dtype),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [rows, m]
+            s_parts.append(jnp.where(causal, s_cc, -jnp.inf))
+        s_cc = jnp.concatenate(s_parts, axis=0)  # [KV*rows, m]
+        m2 = jnp.maximum(m_fin, jnp.max(s_cc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_fin - m2)
+        p_cc = jnp.exp(s_cc - m2)
+        l2 = l_fin * alpha + jnp.sum(p_cc, axis=-1, keepdims=True)
+        pv = []
+        for g in range(kv_heads):
+            pv.append(
+                jax.lax.dot(
+                    p_cc[g * rows : (g + 1) * rows].astype(dtype),
+                    v_s[b, :, g, :].astype(dtype),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        acc = acc * alpha + jnp.concatenate(pv, axis=0)
+        attn_b = acc / l2  # [KV*rows, hd], rows ordered (g, i, gg)
+        attn_rows.append(
+            attn_b.reshape(kv_heads, m, group, head_dim)
+            .transpose(1, 0, 2, 3)
+            .reshape(m, heads * head_dim)
+        )
+
+    attn = jnp.concatenate(attn_rows, axis=0)  # [B*m, H*hd]
+
+    # --- output projection + residual ---------------------------------------
+    o = _wdot(attn.astype(dtype), wo_ref, swo_ref[...], int4=int4)
+    if residual:
+        o = x_ref[...].astype(jnp.float32) + o
+    out_ref[...] = o.astype(out_ref.dtype)
+    for copy in pending:
+        copy.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("heads", "kv_heads", "head_dim", "m", "eps", "residual"),
+)
+def attention_paged_spec_step(
+    x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_pool, v_pool,
+    wo, swo, positions, block_tables, *, heads: int, kv_heads: int,
+    head_dim: int, m: int, eps: float = 1e-6, residual: bool = True,
+):
+    """Fused paged attention for B speculative-verify chunks.
+
+    x: [B*m, D] — stream b's m candidate rows (last emitted token + its
+    m-1 drafts) at positions ``positions[b]..positions[b]+m-1``, rows
+    flattened stream-major; cos_rows/sin_rows: [B*m, hd] rope rows
+    gathered at every flattened position; block_tables: [B, max_pages]
+    int32 (0 = the reserved null page). Rejected tail rows the write
+    leaves behind are overwritten by the next chunk before any sweep
+    can attend them (the spec_decode invariant: the next chunk starts
+    at the first rejected position). Callers must keep
+    ``positions[b] + m <= max_seq`` (the spec headroom contract, in the
+    engine enforced by ``pages_needed``/``fits``). Returns
+    (x_out [B*m, D], k_pool, v_pool).
+    """
+    bm, d = x.shape
+    assert bm % m == 0, (bm, m)
+    batch = bm // m
+    page = k_pool.shape[2]
+    assert page % 8 == 0, page
+    seq = block_tables.shape[1] * page
+    win = (7 + m + 7) // 8 * 8  # aligned row window covering all m rows
+    assert win <= seq, (win, seq)
+    n_qkv = wqkv.shape[1]
+    kernel = functools.partial(
+        _attn_paged_spec_kernel, heads=heads, kv_heads=kv_heads,
+        head_dim=head_dim, page=page, eps=eps, batch=batch, m=m, win=win,
+        seq=seq, residual=residual,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # x
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # norm_w
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
+            pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, batch, kv_heads, win, head_dim), k_pool.dtype),
+            pltpu.VMEM((kv_heads, page, head_dim), k_pool.dtype),
+            pltpu.VMEM((kv_heads, page, head_dim), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SemaphoreType.DMA((2, batch, win // 8)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (bm, d), x.dtype if residual else jnp.float32
+            ),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        input_output_aliases={9: 1, 10: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=_interpret(),
+    )(
+        jnp.asarray(positions, jnp.int32).reshape(batch),
+        jnp.asarray(block_tables, jnp.int32),
+        x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
+        cos_rows, sin_rows, k_pool, v_pool, wo, swo,
+    )
+
+
 # ---------------------------------------------------------------------------
 # MLP block
 # ---------------------------------------------------------------------------

@@ -30,9 +30,15 @@ reporting the wall-clock overhead of the request-lifecycle span
 records — the serving counterpart of bench.py's recorder A/B gate
 (≤3%).
 
+A fifth axis behind ``--spec-ab``: speculative decoding inside the
+fused window (DORA_SPEC_K), spec_k in {0, 2, 4} x K in {1, 8} on the
+stub engine's repetitive (best-case acceptance) and random (worst-case)
+token rules — tokens per dispatch and acceptance rate per cell.
+
 Usage::
 
-    python -m dora_tpu.tools.bench_serving [--multistep | --trace-ab]
+    python -m dora_tpu.tools.bench_serving [--multistep | --trace-ab |
+                                            --spec-ab]
 """
 
 from __future__ import annotations
@@ -249,11 +255,86 @@ def _trace_ab(qwen2, path: str, real: bool) -> dict:
     }
 
 
+def _spec_ab() -> dict:
+    """Speculative decoding A/B behind ``--spec-ab``: acceptance rate x
+    tokens-per-dispatch, spec_k in {0, 2, 4} crossed with window K in
+    {1, 8}, on the stub paged engine — the REAL spec window program
+    (ngram lookup, k+1-row verify, ragged emission) over a weight-free
+    token rule, so ACCEPTANCE is controlled by construction instead of
+    depending on what a tiny random model happens to repeat:
+
+    * ``repetitive`` — the period-4 cycle rule, prompt-lookup's best
+      case (looping/templated text): drafts come true, every verify
+      accepts, dispatches collapse.
+    * ``random`` — the affine full-period rule: a trailing ngram's
+      continuation never repeats, ~0% acceptance, every dispatch pays
+      the k+1-row verify for one token — the worst-case overhead leg.
+
+    Tokens-per-dispatch reads host counter deltas around the measured
+    run (warmup leg compiles the shapes), the same methodology as the
+    ``--multistep`` sweep — counts, not clocks."""
+    from dora_tpu.metrics import ServingMetrics
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    max_seq, page_size, chunk, max_new, streams = 128, 8, 16, 96, 4
+    prompts = [[5], [6], [7], [8]]
+    out: dict = {
+        "max_new": max_new,
+        "streams": streams,
+        "legs": {},
+    }
+    for leg, cycle in (("repetitive", 4), ("random", None)):
+        leg_out: dict = {}
+        for K in (1, 8):
+            for k in (0, 2, 4):
+                engine = make_stub_paged_engine(
+                    max_slots=streams, max_seq=max_seq,
+                    page_size=page_size, chunk=chunk, window=K,
+                    spec_k=k, cycle=cycle,
+                )
+                _serve(engine, prompts, 4)  # warmup: compile only
+                engine.serving_metrics = ServingMetrics(engine="paged")
+                d0 = engine.dispatches
+                tokens, _wall, _ = _serve(engine, prompts, max_new)
+                sm = engine.serving_metrics
+                leg_out[f"k{k}_K{K}"] = {
+                    "tokens": tokens,
+                    "dispatches": engine.dispatches - d0,
+                    "tokens_per_dispatch": round(
+                        tokens / (engine.dispatches - d0), 2
+                    ),
+                    "acceptance": (
+                        round(sm.spec_accepted / sm.spec_drafted, 3)
+                        if sm.spec_drafted
+                        else None
+                    ),
+                }
+        out["legs"][leg] = leg_out
+    # Acceptance headlines: spec-on vs spec-off at the shipped window
+    # (K=8) — the >=1.5x repetitive gate and the <=10% random-leg
+    # regression bound.
+    rep, rnd = out["legs"]["repetitive"], out["legs"]["random"]
+    out["rep_k4_vs_k0_tpd_at_k8"] = round(
+        rep["k4_K8"]["tokens_per_dispatch"]
+        / rep["k0_K8"]["tokens_per_dispatch"], 2
+    )
+    out["rand_k4_vs_k0_tpd_at_k8"] = round(
+        rnd["k4_K8"]["tokens_per_dispatch"]
+        / rnd["k0_K8"]["tokens_per_dispatch"], 2
+    )
+    return out
+
+
 def main() -> int:
     import numpy as np
 
     from dora_tpu.models.hf import qwen2
 
+    if "--spec-ab" in sys.argv[1:]:
+        # Stub-engine leg: no checkpoint needed, acceptance is shaped
+        # by the token rule, not model weights.
+        print(json.dumps({"spec_ab": _spec_ab()}))
+        return 0
     path = os.environ.get("DORA_HF_CHECKPOINT")
     real = bool(path)
     tmp = None

@@ -101,6 +101,121 @@ def test_drain_admit_streams_token_identical():
         assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
 
 
+# ---------------------------------------------------------------------------
+# speculation × recovery: resume/migrate mid-generation with drafting on
+# ---------------------------------------------------------------------------
+
+
+def _mk_spec_engine(max_slots: int = 2, spec_k: int = 4, window: int = 1):
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    # cycle rule: period-4 token loop, the prompt-lookup best case —
+    # drafts actually accept, so the snapshot carries real history.
+    return make_stub_paged_engine(
+        max_slots=max_slots, max_seq=64, page_size=8, chunk=16,
+        window=window, spec_k=spec_k, cycle=4,
+    )
+
+
+def _spec_reference(max_new: int = 10) -> dict[str, list[int]]:
+    ref = _mk_spec_engine(spec_k=0)
+    ref.submit("r0", [5], max_new)
+    ref.submit("r1", [6], max_new)
+    tokens: dict[str, list[int]] = {}
+    _run_to_done(ref, tokens)
+    assert len(tokens["r0"]) == max_new and len(tokens["r1"]) == max_new
+    return tokens
+
+
+# One K=8 spec window can emit up to K*(spec_k+1) = 40 tokens, so the
+# mid-generation snapshot needs max_new past that (and one step); K=1
+# uses the small/slow shape.
+@pytest.mark.parametrize(
+    "window,max_new,pre_steps", [(1, 10, 4), (8, 45, 1)]
+)
+def test_spec_checkpoint_restore_token_identical(window, max_new, pre_steps):
+    """Checkpoint/restore with speculation ON: the snapshot carries the
+    draft-lookup history, and pre + post tokens equal the uninterrupted
+    spec-off reference — verification keeps resumes greedy-exact."""
+    ref = _spec_reference(max_new)
+
+    a = _mk_spec_engine(window=window)
+    a.submit("r0", [5], max_new)
+    a.submit("r1", [6], max_new)
+    pre: dict[str, list[int]] = {}
+    for _ in range(pre_steps):
+        for key, token, done in a.step():
+            pre.setdefault(key, []).append(int(token))
+    assert a.active == 2, "snapshot must land mid-generation"
+    snap = json.loads(json.dumps(a.checkpoint_state()))
+    for meta in snap["slots"]:
+        if meta.get("decode"):
+            assert meta.get("history"), "spec snapshot must carry history"
+
+    b = _mk_spec_engine(window=window)
+    assert set(b.restore_state(snap)) == {"r0", "r1"}
+    post: dict[str, list[int]] = {}
+    _run_to_done(b, post)
+    for rid in ("r0", "r1"):
+        assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+
+
+def test_spec_restore_from_specless_snapshot():
+    """A snapshot written by a spec-OFF engine (no history field)
+    restores into a spec-ON engine token-identically: the lookup seeds
+    from the last token (cold acceptance), and verification makes the
+    output exact regardless of draft quality."""
+    ref = _spec_reference()
+
+    a = _mk_spec_engine(spec_k=0)
+    a.submit("r0", [5], 10)
+    a.submit("r1", [6], 10)
+    pre: dict[str, list[int]] = {}
+    for _ in range(4):
+        for key, token, done in a.step():
+            pre.setdefault(key, []).append(int(token))
+    snap = json.loads(json.dumps(a.checkpoint_state()))
+    assert all("history" not in m for m in snap["slots"])
+
+    b = _mk_spec_engine(spec_k=4)
+    b.restore_state(snap)
+    post: dict[str, list[int]] = {}
+    _run_to_done(b, post)
+    for rid in ("r0", "r1"):
+        assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+
+
+def test_spec_drain_admit_token_identical():
+    """Live migration with speculation ON: drain releases every page on
+    the source; the target continues each stream token-identically and
+    its acceptance counters actually move (history traveled too)."""
+    ref = _spec_reference()
+
+    a = _mk_spec_engine()
+    a.submit("r0", [5], 10)
+    a.submit("r1", [6], 10)
+    pre: dict[str, list[int]] = {}
+    for _ in range(3):
+        for key, token, done in a.step():
+            pre.setdefault(key, []).append(int(token))
+    state = a.drain_streams()
+    assert a.active == 0
+    assert a.free_pages == a.allocator.num_pages - 1
+
+    b = _mk_spec_engine()
+    b.serving_metrics = ServingMetrics(engine="paged")
+    assert set(b.admit_streams(json.loads(json.dumps(state)))) == {
+        "r0", "r1",
+    }
+    post: dict[str, list[int]] = {}
+    _run_to_done(b, post)
+    for rid in ("r0", "r1"):
+        assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+    sm = b.serving_metrics
+    assert sm.spec_drafted > 0
+    assert 0 < sm.spec_accepted <= sm.spec_drafted
+
+
 def test_page_allocator_take_specific_pages():
     from dora_tpu.models.batch_engine import PageAllocator
 
@@ -273,3 +388,130 @@ def test_engine_exception_fails_inflight_with_error_finish():
     }
     assert errors == {"wire-ab": "error", "wire-cd": "error"}
     assert node.closed  # serve's finally still ran
+
+
+# ---------------------------------------------------------------------------
+# migrate-in back-pressure: undersized targets defer, races fail retriable
+# ---------------------------------------------------------------------------
+
+
+class _MigrateTargetNode(_ServeNode):
+    """Open stream (keep_alive target) that delivers STOP once the
+    engine has gone idle and a few polls have passed — long enough for
+    the migrate-in poll to run, short enough to keep the test fast."""
+
+    def __init__(self, engine, min_polls: int = 3):
+        super().__init__([])
+        self._engine = engine
+        self._min_polls = min_polls
+        self._polls = 0
+
+    def recv(self, timeout=None):
+        self._polls += 1
+        if (
+            self._polls >= self._min_polls
+            and self._engine.active == 0
+            and not self._engine._prefillq
+        ):
+            return {"type": "STOP"}
+        return None
+
+
+def _write_handoff(migrate_dir, source_engine) -> tuple[str, dict[str, int]]:
+    """Drain ``source_engine`` into a handoff file the target's
+    ``DORA_MIGRATE_DIR`` poll sees, mirroring handle_migrate's format."""
+    import os
+
+    state = source_engine.drain_streams()
+    keys = [m["request_id"] for m in state["slots"]]
+    payload = {
+        "engine": state,
+        "backlog": [],
+        "wire_ids": {k: f"wire-{k}" for k in keys},
+        "seqs": {k: 3 for k in keys},
+        "ctxs": {k: "" for k in keys},
+    }
+    os.makedirs(migrate_dir, exist_ok=True)
+    path = os.path.join(migrate_dir, "streams-1-1.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path, {k: len(m["pages"]) for k, m in zip(
+        keys, state["slots"]
+    )}
+
+
+def test_migrate_in_defers_handoff_target_cannot_admit(tmp_path, monkeypatch):
+    """An undersized target must LEAVE an oversized handoff on disk —
+    unclaimed, for a bigger peer or a later retry — instead of claiming
+    streams it cannot admit and losing them (round-7 known issue)."""
+    import os
+
+    from dora_tpu.nodehub.llm_server import serve
+
+    src = _mk_engine(max_slots=2)
+    src.submit("r0", [5], 10)
+    src.submit("r1", [9], 10)
+    for _ in range(3):
+        src.step()
+    path, _pages = _write_handoff(str(tmp_path), src)
+
+    monkeypatch.setenv("DORA_MIGRATE_DIR", str(tmp_path))
+    target = _mk_engine(max_slots=1)  # one slot for a two-stream handoff
+    metrics = ServingMetrics()
+    node = _MigrateTargetNode(target)
+    serve(
+        node, target, metrics,
+        encode=lambda text: [ord(ch) % 97 for ch in text] or [1],
+        decode_one=lambda t: f" t{t}",
+        max_new_cap=8,
+    )
+    assert os.path.exists(path), "handoff must stay on disk, unclaimed"
+    assert not os.path.exists(path + ".claimed")
+    assert metrics.migrated_in == 0
+    assert node.sent == []  # no half-admitted tokens, no error chunks
+
+
+def test_migrate_in_admit_race_fails_streams_retriable(tmp_path, monkeypatch):
+    """If capacity vanishes between the peek-time fits check and the
+    claim, every handoff stream closes with a retriable
+    ``finish="error"`` chunk under its own wire id — the client can
+    retry; before the fix the streams silently vanished."""
+    import os
+
+    from dora_tpu.nodehub.llm_server import serve
+
+    src = _mk_engine(max_slots=2)
+    src.submit("r0", [5], 10)
+    src.submit("r1", [9], 10)
+    for _ in range(3):
+        src.step()
+    path, _pages = _write_handoff(str(tmp_path), src)
+
+    monkeypatch.setenv("DORA_MIGRATE_DIR", str(tmp_path))
+    target = _mk_engine(max_slots=2)  # fits at peek time...
+
+    def raced(state):  # ...but the admit itself loses the race
+        raise RuntimeError("no free slot for migrated stream")
+
+    target.admit_streams = raced
+    metrics = ServingMetrics()
+    node = _MigrateTargetNode(target)
+    serve(
+        node, target, metrics,
+        encode=lambda text: [ord(ch) % 97 for ch in text] or [1],
+        decode_one=lambda t: f" t{t}",
+        max_new_cap=8,
+    )
+    assert not os.path.exists(path)  # claimed: the failure was consumed
+    errors = {
+        m.get("request_id"): (m.get("finish"), m.get("seq"))
+        for _o, _v, m in node.sent
+        if m.get("done")
+    }
+    # Error chunks carry the MIGRATED seq counter, so consumers dedup
+    # them against the source's stream like any other chunk.
+    assert errors == {"wire-r0": ("error", 3), "wire-r1": ("error", 3)}
+    assert metrics.migrated_in == 0
+    assert metrics.rejected == 2

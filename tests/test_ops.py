@@ -466,6 +466,102 @@ def test_fused_chunk_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(vc2), np.asarray(vfull[0]), atol=1e-5)
 
 
+def test_paged_spec_attention_matches_dense_chunk():
+    """attention_paged_spec_step (batched M-row verify through block
+    tables) matches attention_chunk_step run per stream on the same
+    cache laid out densely: output rows, the M written pool rows, AND
+    bit-preservation of every untouched row. Streams sit at positions
+    that exercise the page-straddle window (pos=6, M=5 crosses a page
+    boundary) and a frozen stream (pos=0, null block table)."""
+    from dora_tpu.ops.decode_block import (
+        attention_chunk_step, attention_paged_spec_step, rope_rows,
+        rope_rows_at,
+    )
+    from dora_tpu.ops.int8_matmul import quantize_int8
+
+    rng = np.random.default_rng(3)
+    D, H, KV, HD, S, M = 64, 4, 2, 16, 64, 5
+    PAGE = 8
+    npages = S // PAGE
+    B = 4
+    positions = [9, 30, 6, 0]  # stream 3: frozen (pos 0, zeroed bt row)
+    frozen = [False, False, False, True]
+
+    x = jnp.asarray(rng.standard_normal((B * M, D)), jnp.float32)
+    nw = jnp.asarray(rng.standard_normal(D), jnp.float32)
+    wqkv = quantize_int8(
+        jnp.asarray(rng.standard_normal((D, (H + 2 * KV) * HD)), jnp.float32)
+    )
+    wo = quantize_int8(jnp.asarray(rng.standard_normal((H * HD, D)), jnp.float32))
+    bqkv = jnp.asarray(rng.standard_normal((H + 2 * KV) * HD), jnp.float32)
+    dense_k = [
+        jnp.asarray(rng.standard_normal((KV, S, HD)), jnp.float32) * 0.1
+        for _ in range(B)
+    ]
+    dense_v = [
+        jnp.asarray(rng.standard_normal((KV, S, HD)), jnp.float32) * 0.1
+        for _ in range(B)
+    ]
+    cos_t, sin_t = L.rope_table(S, HD)
+
+    # Pool: page 0 is the null page; stream b owns pages 1+b*npages ...
+    P = 1 + B * npages
+    k_pool = np.zeros((P, KV, PAGE, HD), np.float32)
+    v_pool = np.zeros((P, KV, PAGE, HD), np.float32)
+    bt = np.zeros((B, npages), np.int32)
+    for b in range(B):
+        if frozen[b]:
+            continue
+        for j in range(npages):
+            pg = 1 + b * npages + j
+            bt[b, j] = pg
+            k_pool[pg] = np.asarray(dense_k[b][:, j * PAGE:(j + 1) * PAGE])
+            v_pool[pg] = np.asarray(dense_v[b][:, j * PAGE:(j + 1) * PAGE])
+
+    pos_arr = jnp.asarray(positions, jnp.int32)
+    flat_pos = (pos_arr[:, None] + jnp.arange(M)[None, :]).reshape(B * M)
+    cosr, sinr = rope_rows_at(cos_t, sin_t, flat_pos)
+
+    xo, kp2, vp2 = attention_paged_spec_step(
+        x, nw, wqkv["int8"], wqkv["scale"], bqkv, cosr, sinr,
+        jnp.asarray(k_pool), jnp.asarray(v_pool), wo["int8"], wo["scale"],
+        pos_arr, jnp.asarray(bt), heads=H, kv_heads=KV, head_dim=HD, m=M,
+    )
+    xo, kp2, vp2 = np.asarray(xo), np.asarray(kp2), np.asarray(vp2)
+
+    for b in range(B):
+        pos = positions[b]
+        cr, sr = rope_rows(cos_t, sin_t, pos, M)
+        ref_xo, kc2, vc2 = attention_chunk_step(
+            x[b * M:(b + 1) * M], nw, wqkv["int8"], wqkv["scale"], bqkv,
+            cr, sr, dense_k[b], dense_v[b], wo["int8"], wo["scale"], pos,
+            heads=H, kv_heads=KV, head_dim=HD,
+        )
+        np.testing.assert_allclose(
+            xo[b * M:(b + 1) * M], np.asarray(ref_xo), atol=3e-7,
+            err_msg=f"stream {b}",
+        )
+        if frozen[b]:
+            continue
+        kc2, vc2 = np.asarray(kc2), np.asarray(vc2)
+        for r in range(pos, pos + M):  # the M written rows
+            pg, off = bt[b, r // PAGE], r % PAGE
+            np.testing.assert_allclose(
+                kp2[pg, :, off], kc2[:, r], atol=3e-7, err_msg=f"{b},{r}"
+            )
+            np.testing.assert_allclose(
+                vp2[pg, :, off], vc2[:, r], atol=3e-7, err_msg=f"{b},{r}"
+            )
+        for r in range(pos):  # rows below pos: bit-preserved
+            pg, off = bt[b, r // PAGE], r % PAGE
+            assert np.array_equal(
+                kp2[pg, :, off], np.asarray(dense_k[b][:, r])
+            ), (b, r)
+            assert np.array_equal(
+                vp2[pg, :, off], np.asarray(dense_v[b][:, r])
+            ), (b, r)
+
+
 def test_speculative_fused_matches_fused_vanilla():
     """On int8-quantized params both speculation (fused M-row chunk
     verify) and vanilla generate ride the kernel tier — tokens must

@@ -340,8 +340,121 @@ def test_16_slots_inside_the_dense_4_slot_footprint(quantized, serial_ref):
 
 
 # ---------------------------------------------------------------------------
-# compile-count regression guard
+# speculative decoding inside the window
 # ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("spec_k", (2, 4))
+@pytest.mark.parametrize("window", (1, 8))
+def test_spec_window_token_identity(quantized, serial_ref, spec_k, window):
+    """Prompt-lookup speculation folded into the paged window emits
+    EXACTLY the spec-off / dense / serial greedy streams at every
+    (K, k): drafts only ever propose, the batched verification pass
+    decides — including multi-chunk prompts admitted mid-decode."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    rng = np.random.default_rng(5)
+    plens = (3, 7, 12, 5)
+    prompts = [rng.integers(0, cfg.vocab, size=n).tolist() for n in plens]
+    max_new = 10
+
+    engine = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=4, page_size=8, chunk=8, window=window,
+        spec_k=spec_k,
+    )
+    assert engine.spec_k == spec_k
+    streams: dict[str, list[int]] = {f"r{i}": [] for i in range(len(plens))}
+    engine.submit("r0", prompts[0], max_new)
+    for _ in range(3):
+        _drain(streams, engine.step())
+    engine.submit("r1", prompts[1], max_new)
+    engine.submit("r2", prompts[2], max_new)
+    _drain(streams, engine.step())
+    engine.submit("r3", prompts[3], max_new)
+    for _ in range(300):
+        if not engine.active:
+            break
+        _drain(streams, engine.step())
+    assert engine.active == 0
+    for i in range(len(plens)):
+        assert streams[f"r{i}"] == serial_ref(prompts[i], max_new), (
+            f"spec k={spec_k} K={window} stream r{i} diverged"
+        )
+    assert engine.free_pages == engine.allocator.num_pages - 1
+
+
+@pytest.mark.parametrize("window", (1, 8))
+def test_spec_window_freezes_streams_mid_chunk(quantized, serial_ref, window):
+    """Completion INSIDE a verified chunk: one stream hits EOS at a
+    draft position, another's max_new expires mid-chunk. The spec
+    window must truncate the tick's emission AT the completing token
+    (later accepted candidates discarded), freeze the stream
+    (null-page KV routing), and the host replay must agree — emitted
+    streams identical to the spec-off engine with the same eos."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, cfg.vocab, size=n).tolist() for n in (4, 6)]
+    max_new = (12, 5)
+    eos = serial_ref(prompts[0], max_new[0])[5]
+
+    def expect(i: int) -> list[int]:
+        out = []
+        for t in serial_ref(prompts[i], max_new[i])[: max_new[i]]:
+            out.append(t)
+            if t == eos:
+                break
+        return out
+
+    def run(spec_k: int):
+        engine = qwen2.make_paged_engine(
+            qparams, cfg, max_slots=2, page_size=8, chunk=8, eos=eos,
+            window=window, spec_k=spec_k,
+        )
+        streams: dict[str, list[int]] = {"r0": [], "r1": []}
+        engine.submit("r0", prompts[0], max_new[0])
+        engine.submit("r1", prompts[1], max_new[1])
+        for _ in range(100):
+            if not engine.active:
+                break
+            _drain(streams, engine.step())
+        assert engine.active == 0
+        assert engine.free_pages == engine.allocator.num_pages - 1
+        return streams
+
+    off = run(0)
+    for spec_k in (2, 4):
+        got = run(spec_k)
+        for rid, i in (("r0", 0), ("r1", 1)):
+            want = expect(i)
+            assert off[rid] == want, f"spec-off {rid}"
+            assert got[rid] == want, f"spec k={spec_k} K={window} {rid}"
+    assert len(off["r0"]) == 6 and len(off["r1"]) == 5
+
+
+def test_spec_headroom_shapes_admission(quantized):
+    """fits()/pages_needed() reserve the verification tail (spec_k + 1
+    rows): a request that fills max_seq exactly is admissible spec-off
+    but must be rejected spec-on — the last verify would write past the
+    sequence end mid-owed-tokens otherwise (the serial gate's contract,
+    in page units)."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    off = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=2, page_size=8, chunk=8, window=1,
+    )
+    on = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=2, page_size=8, chunk=8, window=1, spec_k=4,
+    )
+    assert on.spec_headroom() == 5 and off.spec_headroom() == 0
+    assert off.fits(56, 8)  # 64 rows = max_seq exactly
+    assert not on.fits(56, 8)  # + 5 tail rows would cross max_seq
+    assert on.fits(51, 8)
+    # the tail also costs pages when it crosses a page boundary
+    assert on.pages_needed(3, 30) == off.pages_needed(3, 35)
 
 
 def test_steady_state_adds_zero_compiles_and_one_chunk_shape(quantized):
@@ -393,6 +506,46 @@ def test_steady_state_adds_zero_compiles_and_one_chunk_shape(quantized):
         # every slot-membership pattern the drains walked through.
         assert engine.chunk_prefill._cache_size() == 1, f"K={k}"
         assert engine.window_step._cache_size() == 1, f"K={k}"
+
+
+def test_spec_steady_state_adds_zero_compiles(quantized):
+    """The compile discipline holds with speculation ON: drafts,
+    verification chunks, acceptance lengths and history updates are all
+    traced fixed-shape operands, so steady-state serving (new prompt
+    lengths + ragged acceptance + drains) adds ZERO XLA compiles and
+    the spec window jit holds exactly ONE compiled shape."""
+    from dora_tpu.models.hf import qwen2
+
+    cfg, qparams = quantized
+    engine = qwen2.make_paged_engine(
+        qparams, cfg, max_slots=4, page_size=8, chunk=16, window=8,
+        spec_k=4,
+    )
+    rng = np.random.default_rng(7)
+
+    def run(lengths: tuple[int, ...]) -> None:
+        streams: dict[str, list[int]] = {}
+        for i, n in enumerate(lengths):
+            rid = f"w{n}-{i}"
+            streams[rid] = []
+            while not engine.can_admit(n, 6):
+                _drain(streams, engine.step())
+            engine.submit(rid, rng.integers(0, cfg.vocab, size=n).tolist(), 6)
+            _drain(streams, engine.step())
+        for _ in range(200):
+            if not engine.active:
+                return
+            _drain(streams, engine.step())
+
+    run((3, 12, 20))  # warmup
+    warm = len(_COMPILE_EVENTS)
+    run((5, 9, 17, 33, 2))  # five NEW lengths
+    assert len(_COMPILE_EVENTS) == warm, (
+        f"spec-on steady state compiled "
+        f"{len(_COMPILE_EVENTS) - warm} new XLA program(s)"
+    )
+    assert engine.chunk_prefill._cache_size() == 1
+    assert engine.window_step._cache_size() == 1
 
 
 def test_dense_engine_mask_cached_across_unchanged_passes(quantized):

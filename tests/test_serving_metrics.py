@@ -41,6 +41,18 @@ def test_serving_snapshot_shape():
     assert snap["dispatch_gap_us"]["count"] == 1
     # No dispatches yet -> no rate, not a div-by-zero.
     assert ServingMetrics().snapshot()["tokens_per_dispatch"] is None
+    # Speculative-decoding counters: acceptance is accepted/drafted,
+    # None (not 0/0) when the engine never drafted.
+    m.spec_drafted = 40
+    m.spec_accepted = 30
+    m.spec_accept_len.observe(3)
+    m.spec_accept_len.observe(5)
+    snap = m.snapshot()
+    assert snap["spec_drafted"] == 40
+    assert snap["spec_accepted"] == 30
+    assert snap["spec_acceptance"] == 0.75
+    assert snap["spec_accept_len"]["count"] == 2
+    assert ServingMetrics().snapshot()["spec_acceptance"] is None
 
 
 def test_merge_unions_serving_across_daemons():
@@ -75,6 +87,9 @@ def test_render_serving_table_with_rates():
                     "host_dispatches": 30,
                     "host_fetches": 28,
                     "tokens_per_dispatch": 5.0,
+                    "spec_drafted": 200,
+                    "spec_accepted": 130,
+                    "spec_acceptance": 0.65,
                     "ttft_us": {
                         "count": 4, "p50_us": 2500.0, "p90_us": 8000.0,
                         "p99_us": 9000.0,
@@ -96,6 +111,7 @@ def test_render_serving_table_with_rates():
     assert "50.0" in out  # (150 - 50) / 2.0 tok/s
     assert "2.5ms" in out  # ttft p50
     assert "TOK/DISP" in out and "5.0" in out  # tokens per dispatch
+    assert "ACC%" in out and "65%" in out  # speculative acceptance rate
     assert "GAP P50" in out and "512µs" in out  # dispatch-gap histogram
     assert "FETCH P50" in out and "256µs" in out  # fetch split from gap
     assert "COMPILES" in out and "6" in out  # xla compile audit counter
@@ -107,7 +123,8 @@ def test_render_serving_table_with_rates():
     bare = snap(10)
     for key in ("tokens_per_dispatch", "dispatch_gap_us", "fetch_us",
                 "used_pages", "peak_used_pages", "largest_contig_free",
-                "compiles"):
+                "compiles", "spec_drafted", "spec_accepted",
+                "spec_acceptance"):
         del bare["serving"]["llm"][key]
     assert "llm (paged)" in render_metrics("u", bare)
 
