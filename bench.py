@@ -33,7 +33,13 @@ Prints exactly ONE JSON line (the last line of stdout):
    "p50_us_1kib": {...}, "p99_us_1kib": {...},
    "recorder_ab": {"off_msgs_per_sec": ..., "on_msgs_per_sec": ...,
                    "overhead_pct": ...},
+   "history_prom_ab": {...}, "trend": {...},
    "e2e_fps": ..., "e2e_vs_north_star": ...}
+
+Every run is also appended to ``BENCH_history.jsonl`` (see
+``dora_tpu.tools.bench_trend``) with an environment fingerprint and an
+ambient-throughput calibration; >10% regressions vs the previous
+fingerprint-matched run are flagged on stderr and in ``trend``.
 """
 
 from __future__ import annotations
@@ -428,6 +434,49 @@ def tracing_ab_leg() -> dict:
     }
 
 
+def history_prom_ab_leg() -> dict:
+    """Time-series-plane A/B on the daemon route: history sampling off
+    (DORA_METRICS_HISTORY_S=0) vs on at an aggressive 0.5 s cadence with
+    the coordinator's Prometheus endpoint bound (DORA_PROM_PORT=0 picks
+    an ephemeral port), runs interleaved. Each sample is one
+    metrics_snapshot + dict diff on the daemon loop — off the per-message
+    hot path — so the budget is the observability ≤3% on msgs_per_sec."""
+    off: list[float] = []
+    on: list[float] = []
+    for i in range(SMALL_RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-hist-") as tmp:
+            off.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={"DORA_METRICS_HISTORY_S": "0"},
+                )["msgs_per_sec"]
+            )
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-hist-") as tmp:
+            on.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={
+                        "DORA_METRICS_HISTORY_S": "0.5",
+                        "DORA_PROM_PORT": "0",
+                    },
+                )["msgs_per_sec"]
+            )
+        print(
+            f"# history/prom A/B run {i + 1}/{SMALL_RUNS}: "
+            f"off {off[-1]:.0f} msg/s, on {on[-1]:.0f} msg/s",
+            file=sys.stderr,
+        )
+    off_m = statistics.median(off)
+    on_m = statistics.median(on)
+    return {
+        "off_msgs_per_sec": round(off_m, 0),
+        "on_msgs_per_sec": round(on_m, 0),
+        "overhead_pct": (
+            round((off_m - on_m) / off_m * 100, 2) if off_m else None
+        ),
+    }
+
+
 def serving_engine_ab() -> dict:
     """Paged-vs-dense serving engine A/B (tools/bench_serving): decode
     tok/s + TTFT p50/p99 at 4 streams (both engines, the ±3% parity
@@ -716,6 +765,16 @@ def main() -> int:
         }
 
     try:
+        history_prom_ab = history_prom_ab_leg()
+    except Exception as exc:
+        history_prom_ab = {
+            "off_msgs_per_sec": None,
+            "on_msgs_per_sec": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         engine_ab = serving_engine_ab()
     except Exception as exc:
         engine_ab = {
@@ -785,6 +844,7 @@ def main() -> int:
         "small_msg_detail": small,
         "recorder_ab": recorder_ab,
         "tracing_ab": tracing_ab,
+        "history_prom_ab": history_prom_ab,
         "serving_engine_ab": engine_ab,
         "serving_multistep_ab": multistep_ab,
         "serving_trace_ab": trace_ab,
@@ -799,6 +859,23 @@ def main() -> int:
         "e2e_p50_gap_ms": e2e.get("p50_gap_ms"),
         "e2e_note": e2e["note"],
     }
+    # Trend tracking: append this run to BENCH_history.jsonl and flag
+    # >10% regressions vs the previous fingerprint-matched run (skipped
+    # when the machine's own calibration moved).
+    try:
+        from dora_tpu.tools import bench_trend
+
+        record["trend"] = bench_trend.record_run(
+            record, Path(__file__).resolve().parent / "BENCH_history.jsonl"
+        )
+        for reg in record["trend"].get("regressions", []):
+            print(
+                f"# REGRESSION {reg['metric']}: {reg['previous']} -> "
+                f"{reg['current']} ({reg['worse_pct']}% worse)",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # trend tracking must never sink the bench
+        record["trend"] = {"note": f"trend tracking failed: {exc!r}"[:200]}
     print(json.dumps(record))
     return 0
 

@@ -380,9 +380,23 @@ def cmd_metrics(args) -> int:
             if args.json:
                 print(json.dumps(reply.metrics, indent=2, sort_keys=True))
                 return 0
-            # Rates divide by the MEASURED time since the previous
-            # snapshot, not the nominal --interval (a slow control-plane
-            # round trip would otherwise inflate every rate).
+            # Watch rates come from the daemon-side history ring
+            # (server-side deltas: first tick has real rates, counter
+            # resets already handled in the ring). CLI-side two-snapshot
+            # diffing over the MEASURED elapsed time stays as the
+            # fallback for daemons with history sampling disabled.
+            rates = None
+            if args.watch:
+                hist_reply = c.request(
+                    cm.QueryMetricsHistory(
+                        dataflow_uuid=args.uuid, name=args.name
+                    )
+                )
+                if (
+                    not isinstance(hist_reply, cm.Error)
+                    and hist_reply.history.get("samples")
+                ):
+                    rates = hist_reply.history.get("rates")
             elapsed = now - last_at if last_at is not None else None
             text = render_metrics(
                 reply.dataflow_uuid,
@@ -393,6 +407,7 @@ def cmd_metrics(args) -> int:
                     if args.watch else None
                 ),
                 history=history if args.watch else None,
+                rates=rates,
             )
             if not args.watch:
                 print(text, end="")
@@ -402,6 +417,43 @@ def cmd_metrics(args) -> int:
             history.append(reply.metrics)
             del history[:-48]  # sparkline window
             last_at = now
+            time.sleep(args.interval)
+
+
+def cmd_top(args) -> int:
+    """Live full-cluster dashboard: nodes, queues, SERVING, RECOVERY,
+    PAGES and SLO burn, with rates and sparklines drawn from the
+    daemon-side metrics history ring (QueryMetricsHistory)."""
+    import json
+
+    from dora_tpu.cli.top_view import render_top
+
+    with _control(args) as c:
+        while True:
+            reply = c.request(
+                cm.QueryMetrics(dataflow_uuid=args.uuid, name=args.name)
+            )
+            if isinstance(reply, cm.Error):
+                print(reply.message, file=sys.stderr)
+                return 1
+            hist_reply = c.request(
+                cm.QueryMetricsHistory(
+                    dataflow_uuid=args.uuid, name=args.name
+                )
+            )
+            if isinstance(hist_reply, cm.Error):
+                print(hist_reply.message, file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(hist_reply.history, indent=2, sort_keys=True))
+                return 0
+            text = render_top(
+                reply.dataflow_uuid, reply.metrics, hist_reply.history
+            )
+            if args.once:
+                print(text, end="")
+                return 0
+            print("\x1b[2J\x1b[H" + text, end="", flush=True)
             time.sleep(args.interval)
 
 
@@ -607,6 +659,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     coordinator_addr(p)
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "top",
+        help="live cluster dashboard (rates/sparklines from the history ring)",
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument(
+        "--interval", type=float, default=2.0, help="refresh seconds"
+    )
+    p.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the raw merged history instead of the dashboard",
+    )
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser(
         "trace",

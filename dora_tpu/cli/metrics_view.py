@@ -66,15 +66,19 @@ def render_metrics(
     prev: dict | None = None,
     interval: float | None = None,
     history: list[dict] | None = None,
+    rates: dict | None = None,
 ) -> str:
     """One screenful: header (fastroute ratio), per-link throughput table,
-    per-input latency/backlog table. ``prev`` + ``interval`` (watch mode)
-    turn counter deltas into msg/s / bytes/s rates; ``interval`` is the
-    MEASURED wall time between the two snapshots, clamped to >= 1 ms
-    (snapshots come from different daemons — a skewed or back-to-back
-    pair must not explode a rate or divide by ~0). ``history`` (older
-    snapshots, oldest first) draws the page-occupancy sparkline under
-    the SERVING table."""
+    per-input latency/backlog table. ``rates`` (the ``rates`` block of a
+    merged QueryMetricsHistory reply) supplies server-side rates from the
+    daemon history ring — the preferred watch-mode source: the first tick
+    already has them and counter resets were handled in the ring.
+    ``prev`` + ``interval`` are the legacy CLI-side fallback (no history
+    ring on the daemon): counter deltas over the MEASURED wall time
+    between the two snapshots, clamped to >= 1 ms (snapshots come from
+    different daemons — a skewed or back-to-back pair must not explode a
+    rate or divide by ~0). ``history`` (older snapshots, oldest first)
+    draws the page-occupancy sparkline under the SERVING table."""
     fr = snap.get("fastroute", {})
     ratio = fr.get("hit_ratio")
     header = f"dataflow {uuid}"
@@ -90,12 +94,18 @@ def render_metrics(
     lines = [header, ""]
 
     dt = max(interval, 1e-3) if interval is not None else None
+    per_key = (rates or {}).get("per_key", {})
     prev_links = (prev or {}).get("links", {})
     link_rows = []
     for key in sorted(snap.get("links", {})):
         v = snap["links"][key]
         row = [key, str(v.get("msgs", 0)), _fmt_bytes(v.get("bytes", 0))]
-        if dt:
+        if rates is not None:
+            row.append(f"{per_key.get(f'link:{key}:msgs', 0.0):.1f}")
+            row.append(
+                f"{_fmt_bytes(per_key.get(f'link:{key}:bytes', 0.0))}/s"
+            )
+        elif dt:
             before = prev_links.get(key, {})
             row.append(_rate(v.get("msgs", 0), before.get("msgs", 0), dt))
             bdelta = v.get("bytes", 0) - before.get("bytes", 0)
@@ -104,7 +114,7 @@ def render_metrics(
             )
         link_rows.append(row)
     headers = ["LINK", "MSGS", "BYTES"]
-    if dt:
+    if rates is not None or dt:
         headers += ["MSG/S", "BYTES/S"]
     if link_rows:
         lines += _table(headers, link_rows) + [""]
@@ -143,7 +153,10 @@ def render_metrics(
             gap = s.get("dispatch_gap_us", {})
             fetch = s.get("fetch_us", {})
             toks = s.get("decode_tokens", 0)
-            if dt:
+            if rates is not None:
+                node_tps = (rates.get("tokens_per_s") or {}).get(nid)
+                tps = f"{node_tps:.1f}" if node_tps is not None else "0.0"
+            elif dt:
                 before = prev_serving.get(nid, {})
                 tps = _rate(toks, before.get("decode_tokens", 0), dt)
             else:

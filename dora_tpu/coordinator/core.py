@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -91,6 +92,11 @@ class Coordinator:
         #: correlation for metrics requests: (dataflow_id, machine) -> future
         self._metrics_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._trace_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        self._history_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        #: Prometheus exposition endpoint (DORA_PROM_PORT)
+        self._prom_server: asyncio.AbstractServer | None = None
+        self.prom_port: int | None = None
+        self._otlp_task: asyncio.Task | None = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -106,11 +112,29 @@ class Coordinator:
         )
         self.control_port = self._control_server.sockets[0].getsockname()[1]
         self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
+        # Prometheus text exposition (DORA_PROM_PORT; empty = off, 0 = a
+        # free port, surfaced as self.prom_port).
+        prom_port = os.environ.get("DORA_PROM_PORT", "")
+        if prom_port != "":
+            self._prom_server = await asyncio.start_server(
+                self._handle_prom_scrape, host="0.0.0.0", port=int(prom_port)
+            )
+            self.prom_port = self._prom_server.sockets[0].getsockname()[1]
+        # OTLP push (same endpoint resolution as tracing; no-op without
+        # the otel metrics SDK or an endpoint).
+        from dora_tpu.telemetry import init_cluster_metrics_export
+
+        self._otlp_task = init_cluster_metrics_export(
+            "dora-coordinator", self.prom_snapshots
+        )
 
     async def close(self) -> None:
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
-        for server in (self._daemon_server, self._control_server):
+        if self._otlp_task is not None:
+            self._otlp_task.cancel()
+        for server in (self._daemon_server, self._control_server,
+                       self._prom_server):
             if server is not None:
                 server.close()
                 try:
@@ -233,6 +257,12 @@ class Coordinator:
             fut = self._trace_waiters.get((event.dataflow_id, event.machine_id))
             if fut is not None and not fut.done():
                 fut.set_result(event.trace)
+        elif isinstance(event, cm.MetricsHistoryReplyFromDaemon):
+            fut = self._history_waiters.get(
+                (event.dataflow_id, event.machine_id)
+            )
+            if fut is not None and not fut.done():
+                fut.set_result(event.history)
         else:
             logger.warning("unexpected daemon event %s", type(event).__name__)
 
@@ -444,6 +474,37 @@ class Coordinator:
                 self._metrics_waiters.pop((uuid, machine), None)
         return merge_snapshots([s for s in snapshots if isinstance(s, dict)])
 
+    async def request_metrics_history(self, uuid: str) -> dict:
+        """Fan a MetricsHistoryRequest out to every involved daemon and
+        merge the per-machine rings onto one clock-aligned timeline
+        (dora_tpu.metrics_history.merge_history_snapshots). Works for
+        archived dataflows too — daemons keep finished dataflow state,
+        ring included."""
+        from dora_tpu.metrics_history import merge_history_snapshots
+
+        df = self.running.get(uuid)
+        if df is None and uuid in self.archived:
+            df = self.archived[uuid][0]
+        if df is None:
+            raise KeyError(f"unknown dataflow {uuid!r}")
+        loop = asyncio.get_running_loop()
+        futs = []
+        for machine in sorted(df.machines):
+            fut = loop.create_future()
+            self._history_waiters[(uuid, machine)] = fut
+            self._daemon_send(machine, cm.MetricsHistoryRequest(dataflow_id=uuid))
+            futs.append(fut)
+        try:
+            snapshots = await asyncio.wait_for(
+                asyncio.gather(*futs, return_exceptions=True), timeout=10
+            )
+        finally:
+            for machine in df.machines:
+                self._history_waiters.pop((uuid, machine), None)
+        return merge_history_snapshots(
+            [s for s in snapshots if isinstance(s, dict)]
+        )
+
     async def request_trace(self, uuid: str) -> dict:
         """Fan a TraceRequest out to every involved daemon and merge the
         per-machine ring snapshots onto one clock-aligned timeline
@@ -473,6 +534,74 @@ class Coordinator:
         return merge_trace_snapshots(
             [s for s in snapshots if isinstance(s, dict)]
         )
+
+    # ------------------------------------------------------------------
+    # Prometheus exposition (DORA_PROM_PORT) + OTLP push
+    # ------------------------------------------------------------------
+
+    async def prom_snapshots(self) -> dict[str, dict]:
+        """Merged snapshots of every running + archived dataflow, keyed
+        by exposition label (name when set, uuid otherwise). Archived
+        dataflows whose daemons are gone time out quickly rather than
+        wedging the scrape."""
+        targets = [(u, df.name) for u, df in self.running.items()]
+        targets += [
+            (u, df.name)
+            for u, (df, _) in self.archived.items()
+            if u not in self.running
+        ]
+        out: dict[str, dict] = {}
+        for uuid, name in targets:
+            label = name or uuid
+            if label in out:
+                label = uuid  # name collision across runs: fall back
+            try:
+                out[label] = await asyncio.wait_for(
+                    self.request_metrics(uuid), timeout=3
+                )
+            except Exception:
+                continue
+        return out
+
+    async def _handle_prom_scrape(self, reader, writer) -> None:
+        """Minimal HTTP/1.1 for `GET /metrics` — one endpoint, close
+        after response; anything fancier belongs behind a real scraper."""
+        from dora_tpu import prom
+
+        try:
+            request_line = await reader.readline()
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request_line.decode("latin1").split()
+            path = (parts[1].split("?")[0] if len(parts) > 1 else "/")
+            if len(parts) > 1 and parts[0] == "GET" and path in ("/metrics", "/"):
+                body = prom.render_exposition(await self.prom_snapshots())
+                payload = body.encode()
+                status = "200 OK"
+                ctype = prom.CONTENT_TYPE
+            else:
+                payload = b"not found\n"
+                status = "404 Not Found"
+                ctype = "text/plain"
+            writer.write(
+                (
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + payload
+            )
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # log streaming
@@ -628,6 +757,12 @@ class Coordinator:
                 return uuid
             metrics = await self.request_metrics(uuid)
             return cm.MetricsReply(dataflow_uuid=uuid, metrics=metrics)
+        if isinstance(request, cm.QueryMetricsHistory):
+            uuid = self._query_target(request.dataflow_uuid, request.name)
+            if isinstance(uuid, cm.Error):
+                return uuid
+            history = await self.request_metrics_history(uuid)
+            return cm.MetricsHistoryReply(dataflow_uuid=uuid, history=history)
         if isinstance(request, cm.QueryTrace):
             uuid = self._query_target(request.dataflow_uuid, request.name)
             if isinstance(uuid, cm.Error):

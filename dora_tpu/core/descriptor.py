@@ -199,6 +199,76 @@ class RestartPolicy:
 
 
 @dataclass(frozen=True)
+class SloPolicy:
+    """Per-node service-level objectives (``slo:`` in the descriptor).
+
+    Targets are evaluated against the daemon's metrics history ring
+    (``dora_tpu.metrics_history``) every sampling interval; violations
+    flag the sample, feed the 1 m / 10 m burn-rate gauges, and land in
+    the flight recorder as ``slo_violation`` instants on the trace
+    timeline. All targets are optional; an empty mapping is rejected
+    (an ``slo:`` block that checks nothing is a descriptor bug).
+    """
+
+    ttft_p99_ms: float | None = None
+    tokens_per_s_min: float | None = None
+    queue_depth_max: int | None = None
+
+    @classmethod
+    def parse(cls, value: Any) -> "SloPolicy | None":
+        if value is None:
+            return None
+        if not isinstance(value, Mapping):
+            raise ValueError(
+                f"'slo' must be a mapping, got {type(value).__name__}"
+            )
+        unknown = set(value) - {
+            "ttft_p99_ms", "tokens_per_s_min", "queue_depth_max"
+        }
+        if unknown:
+            raise ValueError(f"unknown slo keys: {sorted(unknown)}")
+        if not value:
+            raise ValueError("'slo' must set at least one objective")
+        for key in ("ttft_p99_ms", "tokens_per_s_min", "queue_depth_max"):
+            raw = value.get(key)
+            if raw is not None and not isinstance(raw, (int, float)):
+                raise ValueError(f"slo {key} must be a number")
+        policy = cls(
+            ttft_p99_ms=(
+                float(value["ttft_p99_ms"])
+                if value.get("ttft_p99_ms") is not None
+                else None
+            ),
+            tokens_per_s_min=(
+                float(value["tokens_per_s_min"])
+                if value.get("tokens_per_s_min") is not None
+                else None
+            ),
+            queue_depth_max=(
+                int(value["queue_depth_max"])
+                if value.get("queue_depth_max") is not None
+                else None
+            ),
+        )
+        for key, target in policy.as_targets().items():
+            if target < 0:
+                raise ValueError(f"slo {key} must be >= 0")
+        return policy
+
+    def as_targets(self) -> dict[str, float]:
+        """Non-None objectives as a plain dict (the history ring's
+        ``slo_targets`` entry and the node's DORA_SLO_* env values)."""
+        out = {}
+        if self.ttft_p99_ms is not None:
+            out["ttft_p99_ms"] = self.ttft_p99_ms
+        if self.tokens_per_s_min is not None:
+            out["tokens_per_s_min"] = self.tokens_per_s_min
+        if self.queue_depth_max is not None:
+            out["queue_depth_max"] = self.queue_depth_max
+        return out
+
+
+@dataclass(frozen=True)
 class CustomNode:
     """A node that is its own executable (or a dynamic/externally-attached
     process)."""
@@ -231,6 +301,7 @@ class ResolvedNode:
     deploy: Deploy
     kind: CustomNode | RuntimeNode
     restart: RestartPolicy | None = None
+    slo: SloPolicy | None = None
 
     @property
     def inputs(self) -> dict[DataId, Input]:
@@ -421,6 +492,7 @@ class Descriptor:
             deploy=deploy,
             kind=kind,
             restart=RestartPolicy.parse(value.get("restart")),
+            slo=SloPolicy.parse(value.get("slo")),
         )
 
     # -- queries ------------------------------------------------------------

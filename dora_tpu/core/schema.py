@@ -144,6 +144,23 @@ _RESTART = {
     ],
 }
 
+_SLO = {
+    "description": (
+        "Service-level objectives for this node, evaluated against the "
+        "metrics history ring every sampling interval; violations feed "
+        "the 1m/10m burn-rate gauges and the trace timeline. At least "
+        "one objective must be set."
+    ),
+    "type": "object",
+    "properties": {
+        "ttft_p99_ms": {"type": "number", "minimum": 0},
+        "tokens_per_s_min": {"type": "number", "minimum": 0},
+        "queue_depth_max": {"type": "integer", "minimum": 0},
+    },
+    "minProperties": 1,
+    "additionalProperties": False,
+}
+
 _NODE = {
     "type": "object",
     "properties": {
@@ -154,6 +171,7 @@ _NODE = {
         "deploy": {"$ref": "#/definitions/deploy"},
         "_unstable_deploy": {"$ref": "#/definitions/deploy"},
         "restart": {"$ref": "#/definitions/restart"},
+        "slo": {"$ref": "#/definitions/slo"},
         # node kinds (exactly one)
         "path": {
             "type": "string",
@@ -249,6 +267,7 @@ def descriptor_schema() -> dict[str, Any]:
             "env": _ENV,
             "deploy": _DEPLOY,
             "restart": _RESTART,
+            "slo": _SLO,
             "communication": _COMMUNICATION,
         },
     }

@@ -298,6 +298,17 @@ async def _serve_connection(
                         ),
                     )
                 )
+            elif isinstance(event, cm.MetricsHistoryRequest):
+                df = daemon.dataflows.get(event.dataflow_id)
+                outbox.put_nowait(
+                    cm.MetricsHistoryReplyFromDaemon(
+                        dataflow_id=event.dataflow_id,
+                        machine_id=machine_id,
+                        history=(
+                            daemon.history_snapshot(df) if df is not None else {}
+                        ),
+                    )
+                )
             elif isinstance(event, cm.DestroyDaemon):
                 return True
             else:

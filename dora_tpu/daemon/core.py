@@ -52,6 +52,7 @@ from dora_tpu.message.common import (
 )
 from dora_tpu.message import fastroute
 from dora_tpu.metrics import DataflowMetrics
+from dora_tpu.metrics_history import MetricsHistoryRing, history_interval_s
 from dora_tpu.telemetry import FLIGHT, OTEL_CTX_KEY, TRACING
 from dora_tpu.message.serde import (
     Timestamped,
@@ -191,6 +192,12 @@ class DataflowState:
     #: parked in next_batch cannot see its socket die, and waking it
     #: with the replayed entries would hand them to a dead connection.
     event_tasks: dict[str, asyncio.Task] = field(default_factory=dict)
+    #: metrics time series: bounded ring of delta-encoded samples
+    #: (dora_tpu.metrics_history; None when DORA_METRICS_HISTORY_S <= 0).
+    #: Retained after finish so QueryMetricsHistory covers archived runs.
+    history: MetricsHistoryRing | None = None
+    #: the sampler task feeding ``history`` (cancelled on finish)
+    history_task: asyncio.Task | None = None
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -261,6 +268,9 @@ class Daemon:
         for df in list(self.dataflows.values()):
             for t in df.timer_tasks:
                 t.cancel()
+            if df.history_task is not None:
+                df.history_task.cancel()
+                df.history_task = None
             # Teardown reaper: node processes must never outlive the
             # daemon (an aborted/timed-out dataflow otherwise leaks
             # wedged nodes holding mapped shmem — observed as orphaned
@@ -317,6 +327,22 @@ class Daemon:
             machine_listen_ports=dict(machine_listen_ports or {}),
         )
         self.dataflows[dataflow_id] = df
+
+        # Metrics history ring + sampler (DORA_METRICS_HISTORY_S <= 0
+        # disables). SLO targets come from the descriptor's per-node
+        # ``slo:`` blocks; violations flag ring samples and land in the
+        # flight recorder as instants on the trace timeline.
+        interval = history_interval_s()
+        if interval > 0:
+            slo_targets = {
+                str(n.id): n.slo.as_targets()
+                for n in descriptor.nodes
+                if n.slo is not None
+            }
+            df.history = MetricsHistoryRing(
+                interval_s=interval, slo_targets=slo_targets
+            )
+            df.history_task = asyncio.create_task(self._history_sampler(df))
 
         # Routing tables (reference: daemon/src/lib.rs:628-660).
         for node in descriptor.nodes:
@@ -732,7 +758,49 @@ class Daemon:
             snap["serving"] = {
                 nid: dict(s) for nid, s in df.node_serving.items()
             }
+        if df.history is not None and df.history.slo_targets:
+            snap["slo"] = df.history.slo_status()
         return snap
+
+    async def _history_sampler(self, df: DataflowState) -> None:
+        """Feed the dataflow's history ring on the configured cadence.
+        SLO violations detected by the ring are recorded as flight
+        instants so they show up on the `dora-tpu trace` timeline."""
+        interval = df.history.interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.sample_history(df)
+            except Exception:
+                logger.exception("history sample failed (%s)", df.id)
+
+    def sample_history(self, df: DataflowState) -> None:
+        """Take one history sample now (sampler tick / final flush)."""
+        if df.history is None:
+            return
+        snap = self.metrics_snapshot(df)
+        hlc_ns = self.clock.new_timestamp().physical_ns
+        events = df.history.sample(snap, time.time_ns(), hlc_ns)
+        for node, objective, observed, target in events:
+            FLIGHT.record(
+                "slo_violation", f"{node}:{objective}",
+                f"observed={observed} target={target}", None,
+            )
+
+    def history_snapshot(self, df: DataflowState) -> dict:
+        """Per-machine history-ring snapshot — the payload of a
+        MetricsHistoryRequest reply. Carries a ``(wall_ns, hlc_ns)``
+        pair captured back to back so the merge
+        (dora_tpu.metrics_history) can align this machine's sample
+        stamps onto the cluster HLC timeline, exactly like the trace
+        merge."""
+        if df.history is None:
+            return {}
+        out = df.history.snapshot()
+        out["machine_id"] = self.machine_id
+        out["hlc_ns"] = self.clock.new_timestamp().physical_ns
+        out["wall_ns"] = time.time_ns()
+        return out
 
     def trace_snapshot(self, df: DataflowState) -> dict:
         """Per-machine trace snapshot for one dataflow — the payload of a
@@ -1095,6 +1163,15 @@ class Daemon:
         for t in df.timer_tasks:
             t.cancel()
         df.timer_tasks.clear()
+        if df.history_task is not None:
+            df.history_task.cancel()
+            df.history_task = None
+            # Final flush: the ring keeps serving archived
+            # QueryMetricsHistory, so capture the tail of the run.
+            try:
+                self.sample_history(df)
+            except Exception:
+                pass
         for queue in df.queues.values():
             queue.release_all_tokens()
             queue.close()

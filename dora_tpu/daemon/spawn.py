@@ -111,6 +111,12 @@ async def spawn_node(
     # (dora_tpu.tools.chaos) finds victim pids by scanning /proc/*/environ
     # for this id; a descriptor env entry may override it.
     env["DORA_CHAOS_ID"] = f"{df.id}:{node.id}"
+    # SLO targets BEFORE node.env so a descriptor env entry can override:
+    # serving nodes (nodehub/llm_server) self-check these in their report
+    # loop and record slo_violation instants on their own trace track.
+    if node.slo is not None:
+        for key, target in node.slo.as_targets().items():
+            env[f"DORA_SLO_{key.upper()}"] = str(target)
     env.update({str(k): str(v) for k, v in node.env.items()})
     env[NODE_CONFIG_ENV] = encode_node_config(node_config)
     # Nodes importing dora_tpu from a source checkout need the repo root.

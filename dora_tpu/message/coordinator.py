@@ -91,6 +91,15 @@ class QueryTrace:
 
 
 @message
+class QueryMetricsHistory:
+    """Fetch the merged, clock-aligned metrics time series of a dataflow
+    (running or finished). Resolution mirrors QueryMetrics."""
+
+    dataflow_uuid: str | None = None
+    name: str | None = None
+
+
+@message
 class MigrateNode:
     """Drain a serving node's live KV streams at a window boundary and
     re-admit them on another engine: the node quiesces, serializes its
@@ -191,6 +200,12 @@ class TraceReply:
 
 
 @message
+class MetricsHistoryReply:
+    dataflow_uuid: str
+    history: dict[str, Any]  # merged series (dora_tpu.metrics_history)
+
+
+@message
 class DaemonConnectedReply:
     connected: bool
 
@@ -272,6 +287,11 @@ class TraceRequest:
 
 
 @message
+class MetricsHistoryRequest:
+    dataflow_id: str
+
+
+@message
 class Heartbeat:
     pass
 
@@ -337,6 +357,13 @@ class TraceReplyFromDaemon:
     dataflow_id: str
     machine_id: str
     trace: dict[str, Any]  # per-machine snapshot (Daemon.trace_snapshot)
+
+
+@message
+class MetricsHistoryReplyFromDaemon:
+    dataflow_id: str
+    machine_id: str
+    history: dict[str, Any]  # per-machine ring (Daemon.history_snapshot)
 
 
 @message

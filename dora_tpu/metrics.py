@@ -318,11 +318,15 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
     serving: dict[str, dict] = {}
     respawns: dict[str, int] = {}
     replayed: dict[str, int] = {}
+    slo: dict[str, dict] = {}
     for snap in snapshots:
         if not snap:
             continue
-        # Each serving node lives on exactly one machine: union.
+        # Each serving node lives on exactly one machine: union. Same
+        # for the SLO burn block — objectives attach to a node, and the
+        # node's daemon evaluates them against its own history ring.
         serving.update(snap.get("serving", {}))
+        slo.update(snap.get("slo", {}))
         recovery = snap.get("recovery") or {}
         for key, c in recovery.get("respawns", {}).items():
             respawns[key] = respawns.get(key, 0) + c
@@ -367,6 +371,8 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
     }
     if serving:
         out["serving"] = serving
+    if slo:
+        out["slo"] = slo
     if respawns or replayed:
         out["recovery"] = {
             "respawns": respawns,

@@ -73,6 +73,7 @@ import time
 
 import pyarrow as pa
 
+from dora_tpu.metrics import percentile_from_counts
 from dora_tpu.node import Node
 
 
@@ -262,6 +263,22 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     ckpt_dir = os.environ.get("DORA_CHECKPOINT_DIR") if can_ckpt else None
     ckpt_every = int(os.environ.get("DORA_CHECKPOINT_EVERY", "8") or 0)
     migrate_dir = os.environ.get("DORA_MIGRATE_DIR") if can_ckpt else None
+    # SLO targets: the daemon injects the descriptor's `slo:` block as
+    # DORA_SLO_* at spawn. The daemon-side history ring is the
+    # authoritative burn-rate source; the node-side check exists so a
+    # violation ALSO lands on this process's ENGINE trace track, with
+    # the observed value at engine granularity.
+    def _slo_env(key: str) -> float | None:
+        raw = os.environ.get(key, "")
+        try:
+            return float(raw) if raw else None
+        except ValueError:
+            return None
+
+    slo_ttft_ms = _slo_env("DORA_SLO_TTFT_P99_MS")
+    slo_tok_s = _slo_env("DORA_SLO_TOKENS_PER_S_MIN")
+    slo_queue = _slo_env("DORA_SLO_QUEUE_DEPTH_MAX")
+    slo_prev: dict = {"t": None, "tokens": 0, "ttft": []}
     #: engine key -> wire request_id. The ENGINE key is always unique
     #: (req-N): two in-flight requests carrying the same wire
     #: ``request_id`` must not share a slot key, or their token streams
@@ -398,6 +415,49 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                     f"free_pages={getattr(engine, 'free_pages', 0)}",
                 )
 
+    def check_slo(now: float) -> None:
+        """Evaluate the DORA_SLO_* targets over the deltas since the
+        previous report tick. TTFT p99 comes from this tick's histogram
+        delta; tok/s is only judged while the engine is actually serving
+        (an idle server decodes 0 tok/s without violating anything)."""
+        if slo_ttft_ms is None and slo_tok_s is None and slo_queue is None:
+            return
+        prev_t, slo_prev["t"] = slo_prev["t"], now
+        toks = metrics.decode_tokens
+        counts = list(metrics.ttft.counts)
+        if prev_t is None or now <= prev_t:
+            slo_prev["tokens"] = toks
+            slo_prev["ttft"] = counts
+            return
+        dt = now - prev_t
+        if slo_ttft_ms is not None:
+            delta = [c - p for c, p in zip(counts, slo_prev["ttft"])]
+            if any(d > 0 for d in delta):
+                p99 = percentile_from_counts(delta, 99)
+                if p99 is not None and p99 > slo_ttft_ms * 1000.0:
+                    tracer.instant(
+                        "slo_violation", "(engine)",
+                        f"ttft_p99_ms observed={p99 / 1000.0:.1f} "
+                        f"target={slo_ttft_ms:g}",
+                    )
+        if slo_tok_s is not None:
+            rate = (toks - slo_prev["tokens"]) / dt
+            if (engine.active or toks > slo_prev["tokens"]) \
+                    and rate < slo_tok_s:
+                tracer.instant(
+                    "slo_violation", "(engine)",
+                    f"tokens_per_s observed={rate:.1f} "
+                    f"target={slo_tok_s:g}",
+                )
+        if slo_queue is not None and len(backlog) > slo_queue:
+            tracer.instant(
+                "slo_violation", "(engine)",
+                f"queue_depth observed={len(backlog)} "
+                f"target={slo_queue:g}",
+            )
+        slo_prev["tokens"] = toks
+        slo_prev["ttft"] = counts
+
     def report(now: float) -> None:
         metrics.slots_active = engine.active
         metrics.slots_total = engine.max_slots
@@ -416,6 +476,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 metrics.largest_contig_free = (
                     alloc.largest_contiguous_free()
                 )
+        check_slo(now)
         try:
             node.report_serving(metrics.snapshot())
         except Exception:

@@ -1,0 +1,324 @@
+"""Prometheus text exposition for the cluster metrics plane.
+
+The coordinator serves this on ``DORA_PROM_PORT`` (``GET /metrics``):
+every running (and still-reachable archived) dataflow's merged snapshot
+(``dora_tpu.metrics.merge_snapshots`` output, SLO block included) is
+flattened into stable metric families with stable labels, rendered in
+text exposition format 0.0.4. The same sample iterator feeds the OTLP
+push path (``telemetry.init_cluster_metrics_export``) so both exporters
+cannot drift apart.
+
+``validate_exposition`` is an offline linter over the rendered text —
+metric/label name charset, TYPE lines, escaping, duplicate series — and
+``self_check`` renders a synthetic cluster and lints it, mirroring
+``tracing.self_check`` (the ``trace --check`` pattern): a bad rename
+fails tier-1, not a scrape.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterator
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: family name -> (type, help). Every sample iter_samples can yield must
+#: be registered here — render and lint both key off this table.
+FAMILIES: dict[str, tuple[str, str]] = {
+    "dora_link_msgs_total": ("counter", "Messages routed per (sender, output) link"),
+    "dora_link_bytes_total": ("counter", "Bytes routed per (sender, output) link"),
+    "dora_drops_total": ("counter", "Inputs dropped (queue full, drop-oldest) per (node, input)"),
+    "dora_queue_depth": ("gauge", "Live input queue depth per (node, input)"),
+    "dora_fastroute_hits_total": ("counter", "Wire fast-path routed messages"),
+    "dora_fastroute_fallbacks_total": ("counter", "Reflective-route fallbacks"),
+    "dora_input_latency_us": ("gauge", "Send-to-deliver latency percentiles per (node, input)"),
+    "dora_respawns_total": ("counter", "Node respawns (restart policy) per node"),
+    "dora_replayed_inputs_total": ("counter", "Un-acked inputs replayed across respawns per node"),
+    "dora_serving_requests_total": ("counter", "Serving requests admitted"),
+    "dora_serving_rejected_total": ("counter", "Serving requests rejected at admission"),
+    "dora_serving_decode_tokens_total": ("counter", "Decode tokens emitted"),
+    "dora_serving_prefill_chunks_total": ("counter", "Prefill chunks dispatched"),
+    "dora_serving_host_dispatches_total": ("counter", "Engine device-program launches"),
+    "dora_serving_compiles_total": ("counter", "XLA compiles observed in the serving process"),
+    "dora_serving_slots_active": ("gauge", "Engine slots currently decoding"),
+    "dora_serving_slots_total": ("gauge", "Engine slot capacity"),
+    "dora_serving_used_pages": ("gauge", "KV pages in use"),
+    "dora_serving_free_pages": ("gauge", "KV pages free"),
+    "dora_serving_total_pages": ("gauge", "KV page-pool capacity"),
+    "dora_serving_backlog_depth": ("gauge", "Requests parked in the admission backlog"),
+    "dora_serving_ttft_us": ("gauge", "Time-to-first-token percentiles"),
+    "dora_slo_burn_rate": ("gauge", "Fraction of the SLO error budget consumed over the window"),
+    "dora_slo_violations_total": ("counter", "SLO-violating history samples per node"),
+}
+
+#: (snapshot serving key, metric family) pairs for the per-node scalars
+_SERVING_COUNTERS = (
+    ("requests", "dora_serving_requests_total"),
+    ("rejected", "dora_serving_rejected_total"),
+    ("decode_tokens", "dora_serving_decode_tokens_total"),
+    ("prefill_chunks", "dora_serving_prefill_chunks_total"),
+    ("host_dispatches", "dora_serving_host_dispatches_total"),
+    ("compiles", "dora_serving_compiles_total"),
+)
+_SERVING_GAUGES = (
+    ("slots_active", "dora_serving_slots_active"),
+    ("slots_total", "dora_serving_slots_total"),
+    ("used_pages", "dora_serving_used_pages"),
+    ("free_pages", "dora_serving_free_pages"),
+    ("total_pages", "dora_serving_total_pages"),
+    ("backlog_depth", "dora_serving_backlog_depth"),
+)
+
+
+def iter_samples(
+    snapshots: dict[str, dict],
+) -> Iterator[tuple[str, dict[str, str], float]]:
+    """``(family, labels, value)`` triples for every sample across all
+    dataflows. ``snapshots`` maps the dataflow label (name or uuid) to
+    its merged metrics snapshot."""
+    for dataflow, snap in snapshots.items():
+        base = {"dataflow": dataflow}
+        for link, v in snap.get("links", {}).items():
+            labels = {**base, "link": link}
+            yield "dora_link_msgs_total", labels, v.get("msgs", 0)
+            yield "dora_link_bytes_total", labels, v.get("bytes", 0)
+        for key, c in snap.get("drops", {}).items():
+            yield "dora_drops_total", {**base, "input": key}, c
+        for key, d in snap.get("queue_depth", {}).items():
+            yield "dora_queue_depth", {**base, "input": key}, d
+        fr = snap.get("fastroute", {})
+        yield "dora_fastroute_hits_total", base, fr.get("hits", 0)
+        yield "dora_fastroute_fallbacks_total", base, fr.get("fallbacks", 0)
+        for key, h in snap.get("latency_us", {}).items():
+            for p in (50, 90, 99):
+                value = h.get(f"p{p}_us")
+                if value is None:
+                    continue
+                yield (
+                    "dora_input_latency_us",
+                    {**base, "input": key, "quantile": f"0.{p}"},
+                    value,
+                )
+        recovery = snap.get("recovery") or {}
+        for node, c in recovery.get("respawns", {}).items():
+            yield "dora_respawns_total", {**base, "node": node}, c
+        for node, c in recovery.get("replayed_inputs", {}).items():
+            yield "dora_replayed_inputs_total", {**base, "node": node}, c
+        for node, s in snap.get("serving", {}).items():
+            labels = {**base, "node": node}
+            for key, family in _SERVING_COUNTERS:
+                yield family, labels, s.get(key, 0) or 0
+            for key, family in _SERVING_GAUGES:
+                yield family, labels, s.get(key, 0) or 0
+            ttft = s.get("ttft_us") or {}
+            for p in (50, 90, 99):
+                value = ttft.get(f"p{p}_us")
+                if value is not None:
+                    yield (
+                        "dora_serving_ttft_us",
+                        {**labels, "quantile": f"0.{p}"},
+                        value,
+                    )
+        for node, entry in snap.get("slo", {}).items():
+            labels = {**base, "node": node}
+            for window in ("1m", "10m"):
+                yield (
+                    "dora_slo_burn_rate",
+                    {**labels, "window": window},
+                    entry.get(f"burn_{window}", 0.0),
+                )
+            yield "dora_slo_violations_total", labels, entry.get("violations", 0)
+
+
+def escape_label_value(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _format_value(value: float) -> str:
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_exposition(snapshots: dict[str, dict]) -> str:
+    """Render all dataflow snapshots as Prometheus text exposition.
+
+    Families are emitted in registry order with their HELP/TYPE header,
+    samples grouped under their family (the format requires it)."""
+    by_family: dict[str, list[str]] = {}
+    for family, labels, value in iter_samples(snapshots):
+        pairs = ",".join(
+            f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
+        )
+        line = f"{family}{{{pairs}}} {_format_value(value)}"
+        by_family.setdefault(family, []).append(line)
+    out: list[str] = []
+    for family, (ftype, help_text) in FAMILIES.items():
+        lines = by_family.get(family)
+        if not lines:
+            continue
+        out.append(f"# HELP {family} {help_text}")
+        out.append(f"# TYPE {family} {ftype}")
+        out.extend(lines)
+    return "\n".join(out) + "\n" if out else ""
+
+
+# ---------------------------------------------------------------------------
+# lint (the `trace --check` pattern)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)(?: (?P<ts>-?\d+))?$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)'
+)
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Lint rendered exposition text; returns a list of problems (empty
+    = valid). Checks the failure modes a scrape would reject: bad
+    metric/label names, samples without a TYPE line, unparseable values,
+    duplicate series, counters not ending in ``_total``."""
+    problems: list[str] = []
+    typed: dict[str, str] = {}
+    seen_series: set[str] = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or not _NAME_RE.match(parts[2]):
+                problems.append(f"line {lineno}: malformed comment: {line!r}")
+                continue
+            if parts[1] == "TYPE":
+                if parts[2] in typed:
+                    problems.append(
+                        f"line {lineno}: duplicate TYPE for {parts[2]}"
+                    )
+                if parts[3] not in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"):
+                    problems.append(
+                        f"line {lineno}: bad type {parts[3]!r}"
+                    )
+                typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name = m.group("name")
+        if name not in typed:
+            problems.append(f"line {lineno}: sample {name} has no TYPE line")
+        elif typed[name] == "counter" and not name.endswith(
+            ("_total", "_created")
+        ):
+            problems.append(
+                f"line {lineno}: counter {name} should end in _total"
+            )
+        raw_labels = m.group("labels") or ""
+        consumed = "".join(
+            mm.group(0) for mm in _LABEL_PAIR_RE.finditer(raw_labels)
+        )
+        if raw_labels and len(consumed) != len(raw_labels):
+            problems.append(
+                f"line {lineno}: malformed labels: {raw_labels!r}"
+            )
+        label_names = [
+            mm.group(1) for mm in _LABEL_PAIR_RE.finditer(raw_labels)
+        ]
+        if len(set(label_names)) != len(label_names):
+            problems.append(f"line {lineno}: duplicate label name")
+        for ln in label_names:
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                problems.append(f"line {lineno}: bad label name {ln!r}")
+        try:
+            float(m.group("value"))
+        except ValueError:
+            problems.append(
+                f"line {lineno}: unparseable value {m.group('value')!r}"
+            )
+        series = f"{name}{{{raw_labels}}}"
+        if series in seen_series:
+            problems.append(f"line {lineno}: duplicate series {series}")
+        seen_series.add(series)
+    return problems
+
+
+def _sample_snapshots() -> dict[str, dict[str, Any]]:
+    """A synthetic two-dataflow cluster exercising every family,
+    including the label-escaping edge cases."""
+    from dora_tpu.metrics import Histogram
+
+    hist = Histogram()
+    for us in (120.0, 900.0, 15000.0):
+        hist.observe(us)
+    return {
+        "camera-vlm": {
+            "links": {'cam/img "hd"': {"msgs": 120, "bytes": 1 << 20}},
+            "drops": {"plot/img": 3},
+            "queue_depth": {"plot/img": 2},
+            "fastroute": {"hits": 110, "fallbacks": 10},
+            "latency_us": {"plot/img": hist.snapshot()},
+            "recovery": {
+                "respawns": {"plot": 1},
+                "replayed_inputs": {"plot": 4},
+            },
+            "serving": {
+                "llm": {
+                    "requests": 42,
+                    "rejected": 2,
+                    "decode_tokens": 4096,
+                    "prefill_chunks": 12,
+                    "host_dispatches": 512,
+                    "compiles": 7,
+                    "slots_active": 3,
+                    "slots_total": 4,
+                    "used_pages": 48,
+                    "free_pages": 16,
+                    "total_pages": 64,
+                    "backlog_depth": 1,
+                    "ttft_us": hist.snapshot(),
+                }
+            },
+            "slo": {
+                "llm": {
+                    "targets": {"ttft_p99_ms": 50.0},
+                    "burn_1m": 0.25,
+                    "burn_10m": 0.05,
+                    "violations": 3,
+                }
+            },
+        },
+        "bench\nrun\\2": {
+            "links": {"a/out": {"msgs": 5, "bytes": 100}},
+            "drops": {},
+            "queue_depth": {},
+            "fastroute": {"hits": 0, "fallbacks": 0},
+            "latency_us": {},
+        },
+    }
+
+
+def self_check() -> list[str]:
+    """Render the synthetic cluster and lint it — the tier-1 guard (and
+    ``dora-tpu metrics --check-prom``) that catches a bad rename before
+    a scrape does."""
+    problems = validate_exposition(render_exposition(_sample_snapshots()))
+    for family in FAMILIES:
+        if not _NAME_RE.match(family) or not family.startswith("dora_"):
+            problems.append(f"bad family name {family!r}")
+    return problems

@@ -717,3 +717,91 @@ def init_metrics(name: str, interval_s: float = 10.0) -> MetricsSampler:
             "opentelemetry SDK not installed; system metrics are local-only"
         )
     return sampler
+
+
+def init_cluster_metrics_export(
+    name: str, collect, interval_s: float = 15.0
+):
+    """OTLP push for the coordinator's cluster metrics plane.
+
+    ``collect`` is an async callable returning ``{dataflow_label:
+    merged_snapshot}`` (the Prometheus endpoint's collector); samples are
+    flattened through ``dora_tpu.prom.iter_samples`` so both exporters
+    share one catalogue. Uses the same endpoint resolution as tracing
+    (:func:`otlp_endpoint`); returns the export task, or None when no
+    endpoint is configured or the otel metrics SDK is absent.
+
+    Instruments are observable gauges created lazily per family the
+    first time a sample for it appears; the periodic reader then pulls
+    the latest collected values through their callbacks.
+    """
+    endpoint = otlp_endpoint()
+    if not endpoint:
+        return None
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (
+            OTLPMetricExporter,
+        )
+        from opentelemetry.metrics import Observation
+        from opentelemetry.sdk.metrics import MeterProvider
+        from opentelemetry.sdk.metrics.export import (
+            PeriodicExportingMetricReader,
+        )
+        from opentelemetry.sdk.resources import Resource
+    except ImportError:
+        logger.warning(
+            "opentelemetry SDK not installed; cluster metrics are "
+            "Prometheus/local-only"
+        )
+        return None
+
+    from dora_tpu.prom import iter_samples
+
+    reader = PeriodicExportingMetricReader(
+        OTLPMetricExporter(endpoint=endpoint),
+        export_interval_millis=interval_s * 1000,
+    )
+    provider = MeterProvider(
+        resource=Resource.create({"service.name": name}),
+        metric_readers=[reader],
+    )
+    meter = provider.get_meter(name)
+    #: family -> [(labels, value)], refreshed by the collect loop and
+    #: read by the per-family gauge callbacks at export time
+    latest: dict[str, list] = {}
+
+    def family_callback(family: str):
+        def callback(_options):
+            return [
+                Observation(float(value), dict(labels))
+                for labels, value in latest.get(family, [])
+            ]
+
+        return callback
+
+    registered: set[str] = set()
+
+    async def _loop():
+        import asyncio
+
+        while True:
+            try:
+                snapshots = await collect()
+                fresh: dict[str, list] = {}
+                for family, labels, value in iter_samples(snapshots):
+                    fresh.setdefault(family, []).append((labels, value))
+                latest.clear()
+                latest.update(fresh)
+                for family in fresh:
+                    if family not in registered:
+                        registered.add(family)
+                        meter.create_observable_gauge(
+                            family, callbacks=[family_callback(family)]
+                        )
+            except Exception:
+                logger.exception("cluster metrics export failed")
+            await asyncio.sleep(interval_s)
+
+    import asyncio
+
+    return asyncio.create_task(_loop())

@@ -79,6 +79,7 @@ INSTANT_NAMES = {
     "node_respawn": "node respawn",
     "replay_inputs": "replay inputs",
     "daemon_reconnect": "daemon reconnect",
+    "slo_violation": "SLO violation",
 }
 
 #: Instants that belong on the engine track and may carry a request

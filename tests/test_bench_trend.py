@@ -1,0 +1,102 @@
+"""Bench trend tracking (tools/bench_trend): history append, environment
+fingerprinting, ambient calibration gating, and >10% regression flags."""
+
+from __future__ import annotations
+
+import json
+
+from dora_tpu.tools import bench_trend
+
+
+def _record(daemon_rate: float, p50: float = 300.0) -> dict:
+    return {
+        "value": p50,
+        "msgs_per_sec_1kib": {"daemon": daemon_rate, "p2p": 9000.0},
+        "p50_us_1kib": {"daemon": 500.0},
+        "p99_us_1kib": {"daemon": 900.0},
+        "e2e_fps": None,
+    }
+
+
+def test_record_run_appends_and_flags_regression(tmp_path, monkeypatch):
+    # Pin the calibration so the comparison gate stays open.
+    monkeypatch.setattr(bench_trend, "ambient_throughput", lambda: 1000.0)
+    history = tmp_path / "BENCH_history.jsonl"
+
+    first = bench_trend.record_run(_record(5000.0), history)
+    assert first["regressions"] == []
+    assert first["baseline_ts"] is None
+
+    # 20% throughput drop on the same machine: flagged.
+    second = bench_trend.record_run(_record(4000.0), history)
+    assert second["baseline_ts"] is not None
+    metrics = {r["metric"] for r in second["regressions"]}
+    assert "msgs_per_sec_1kib.daemon" in metrics
+    reg = next(
+        r for r in second["regressions"]
+        if r["metric"] == "msgs_per_sec_1kib.daemon"
+    )
+    assert reg["worse_pct"] == 20.0
+
+    # Within-budget wobble is not a regression.
+    third = bench_trend.record_run(_record(3900.0), history)
+    assert third["regressions"] == []
+
+    lines = history.read_text().splitlines()
+    assert len(lines) == 3
+    entry = json.loads(lines[0])
+    assert entry["fingerprint"]["id"]
+    assert entry["record"]["msgs_per_sec_1kib"]["daemon"] == 5000.0
+
+
+def test_latency_direction_is_lower_is_better(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench_trend, "ambient_throughput", lambda: 1000.0)
+    history = tmp_path / "h.jsonl"
+    bench_trend.record_run(_record(5000.0, p50=300.0), history)
+    # Latency went UP 50%: regression even though it's a bigger number.
+    out = bench_trend.record_run(_record(5000.0, p50=450.0), history)
+    assert any(r["metric"] == "value" for r in out["regressions"])
+    # Latency improving is never flagged.
+    out = bench_trend.record_run(_record(5000.0, p50=100.0), history)
+    assert out["regressions"] == []
+
+
+def test_calibration_drift_skips_comparison(tmp_path, monkeypatch):
+    rates = iter([1000.0, 500.0])  # machine got 2x slower between runs
+    monkeypatch.setattr(
+        bench_trend, "ambient_throughput", lambda: next(rates)
+    )
+    history = tmp_path / "h.jsonl"
+    bench_trend.record_run(_record(5000.0), history)
+    out = bench_trend.record_run(_record(2000.0), history)
+    # A 60% "regression" on a machine that halved its own speed is not
+    # attributed to the code.
+    assert out["regressions"] == []
+    assert "comparison skipped" in out["note"]
+
+
+def test_fingerprint_mismatch_starts_fresh(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench_trend, "ambient_throughput", lambda: 1000.0)
+    history = tmp_path / "h.jsonl"
+    bench_trend.record_run(_record(5000.0), history)
+    # A knob change (different measured configuration) changes the
+    # fingerprint: no cross-config comparison.
+    monkeypatch.setenv("DORA_SEND_COALESCE", "1")
+    out = bench_trend.record_run(_record(1000.0), history)
+    assert out["baseline_ts"] is None
+    assert out["regressions"] == []
+
+
+def test_torn_history_line_is_ignored(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench_trend, "ambient_throughput", lambda: 1000.0)
+    history = tmp_path / "h.jsonl"
+    bench_trend.record_run(_record(5000.0), history)
+    with history.open("a") as f:
+        f.write('{"truncated": tr\n')  # torn write mid-crash
+    out = bench_trend.record_run(_record(5000.0), history)
+    assert out["baseline_ts"] is not None
+    assert out["regressions"] == []
+
+
+def test_ambient_throughput_measures_something():
+    assert bench_trend.ambient_throughput(budget_s=0.02) > 0

@@ -290,6 +290,49 @@ class TestValidate:
             check_dataflow(d, tmp_path)
 
 
+class TestSlo:
+    def test_parse_and_targets(self):
+        d = parse(
+            "nodes: [{id: a, path: p, "
+            "slo: {ttft_p99_ms: 250, queue_depth_max: 8}}]"
+        )
+        slo = d.nodes[0].slo
+        assert slo.ttft_p99_ms == 250.0
+        assert slo.tokens_per_s_min is None
+        assert slo.queue_depth_max == 8
+        assert slo.as_targets() == {"ttft_p99_ms": 250.0,
+                                    "queue_depth_max": 8}
+
+    def test_absent_is_none(self):
+        assert parse("nodes: [{id: a, path: p}]").nodes[0].slo is None
+
+    @pytest.mark.parametrize(
+        "y,match",
+        [
+            ("nodes: [{id: a, path: p, slo: 5}]", "must be a mapping"),
+            (
+                "nodes: [{id: a, path: p, slo: {}}]",
+                "at least one objective",
+            ),
+            (
+                "nodes: [{id: a, path: p, slo: {bogus: 1}}]",
+                "unknown slo keys",
+            ),
+            (
+                "nodes: [{id: a, path: p, slo: {ttft_p99_ms: fast}}]",
+                "must be a number",
+            ),
+            (
+                "nodes: [{id: a, path: p, slo: {queue_depth_max: -1}}]",
+                "must be >= 0",
+            ),
+        ],
+    )
+    def test_rejected(self, y, match):
+        with pytest.raises(ValueError, match=match):
+            parse(y)
+
+
 def test_mermaid_output():
     d = parse(VLM_YAML)
     mermaid = d.visualize_as_mermaid()

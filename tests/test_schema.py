@@ -63,6 +63,12 @@ REJECTED = [
         "input mapping missing source",
     ),
     ("top: 1\nnodes: [{id: a, path: x.py}]", "unknown top-level key"),
+    ("nodes: [{id: a, path: x.py, slo: {}}]", "empty slo block"),
+    ("nodes: [{id: a, path: x.py, slo: {bogus: 1}}]", "unknown slo key"),
+    (
+        "nodes: [{id: a, path: x.py, slo: {ttft_p99_ms: fast}}]",
+        "non-numeric slo target",
+    ),
 ]
 
 
@@ -74,6 +80,17 @@ def test_schema_and_parser_agree_on_rejection(validator, text, why):
         descriptor = Descriptor.parse(doc)
         for node in descriptor.nodes:  # force input parsing
             node.inputs  # noqa: B018
+
+
+def test_slo_block_validates(validator):
+    doc = yaml.safe_load(
+        "nodes: [{id: a, path: x.py, slo: "
+        "{ttft_p99_ms: 250, tokens_per_s_min: 5.5, queue_depth_max: 8}}]"
+    )
+    assert not list(validator.iter_errors(doc))
+    # The parser agrees: same document resolves.
+    d = Descriptor.parse(doc)
+    assert d.nodes[0].slo.as_targets()["queue_depth_max"] == 8
 
 
 def test_generate_schema_writes_file(tmp_path):

@@ -131,6 +131,19 @@ def test_public_roundtrip_every_class():
         assert decode(encode(obj)) == obj, name
 
 
+def test_metrics_history_messages_are_registered():
+    """The cluster time-series quartet must be wire types: the golden
+    parametrized tests above only cover what the registry holds, so a
+    rename/unregistration would silently drop coverage."""
+    for name in (
+        "QueryMetricsHistory",
+        "MetricsHistoryReply",
+        "MetricsHistoryRequest",
+        "MetricsHistoryReplyFromDaemon",
+    ):
+        assert name in _REGISTRY, name
+
+
 def test_unknown_tag_decodes_as_plain_dict_in_both_paths():
     wire = {"t": "NotARegisteredMessage", "f": {"x": 1}}
     raw = msgpack.packb(wire, use_bin_type=True)
