@@ -621,6 +621,43 @@ def serving_spec_ab() -> dict:
     return data
 
 
+def serving_qos_soak() -> dict:
+    """Traffic-shaping soak (tools/bench_serving --qos-soak): open-loop
+    Poisson mixed-class overload through the real serve() admission
+    path on the stub engine, QoS on vs off over the identical arrival
+    trace. Headline: ``interactive_p99_on_vs_off`` < 1.0 — shaping
+    must buy the interactive class TTFT under overload; shed rate and
+    preempt/resume counts ride along. Fresh subprocess for the same
+    accelerator-claim reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--qos-soak",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "qos_soak" in row:
+            data = row["qos_soak"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "qos_on": None,
+            "qos_off": None,
+            "interactive_p99_on_vs_off": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -813,6 +850,16 @@ def main() -> int:
         }
 
     try:
+        qos_soak = serving_qos_soak()
+    except Exception as exc:
+        qos_soak = {
+            "qos_on": None,
+            "qos_off": None,
+            "interactive_p99_on_vs_off": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -849,6 +896,7 @@ def main() -> int:
         "serving_multistep_ab": multistep_ab,
         "serving_trace_ab": trace_ab,
         "serving_spec_ab": spec_ab,
+        "serving_qos_soak": qos_soak,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

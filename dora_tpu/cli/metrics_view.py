@@ -217,6 +217,39 @@ def render_metrics(
                 f"contig {s.get('largest_contig_free', 0)}"
             ]
 
+    # Traffic-shaping plane: per-class backlog depths plus the shed /
+    # preempt / resume / retune counters. Like RECOVERY, the table only
+    # appears once the QoS machinery has actually done something (or a
+    # class backlog is non-empty) — an unshaped deployment stays clean.
+    if serving:
+        qos_rows = []
+        for nid in sorted(serving):
+            s = serving[nid]
+            depths = s.get("qos_depth") or {}
+            active = (
+                s.get("shed") or s.get("preempted") or s.get("resumed")
+                or s.get("retunes") or any(depths.values())
+            )
+            if not active:
+                continue
+            qos_rows.append([
+                nid,
+                str(depths.get("interactive", 0)),
+                str(depths.get("standard", 0)),
+                str(depths.get("batch", 0)),
+                str(s.get("shed", 0)),
+                str(s.get("preempted", 0)),
+                str(s.get("resumed", 0)),
+                str(s.get("autotune_k", 0) or "-"),
+                str(s.get("retunes", 0)),
+            ])
+        if qos_rows:
+            lines += [""] + _table(
+                ["QOS", "Q:INT", "Q:STD", "Q:BATCH", "SHED", "PREEMPT",
+                 "RESUMED", "K", "RETUNES"],
+                qos_rows,
+            )
+
     # Elastic-recovery plane: daemon-side respawn/replay counters merge
     # with serving-side checkpoint/migration counters by node id. The
     # table only appears once something recovered — steady state stays

@@ -268,6 +268,110 @@ class SloPolicy:
         return out
 
 
+QOS_CLASSES = ("interactive", "standard", "batch")
+
+
+@dataclass(frozen=True)
+class QosPolicy:
+    """Traffic shaping for a serving node (``qos:`` in the descriptor).
+
+    Requests carry a priority class (``interactive`` / ``standard`` /
+    ``batch``); the admission queue drains classes by weight with aging
+    so ``batch`` never starves forever. Per-class depth bounds and the
+    queue-wait deadline turn overload into fast retriable
+    ``overloaded`` chunks instead of unbounded backlog, and
+    ``preempt`` lets an inadmissible higher-class request evict a
+    lower-class decode (recompute-on-resume, token-identical).
+    """
+
+    default_class: str = "standard"
+    depth_interactive: int | None = None
+    depth_standard: int | None = None
+    depth_batch: int | None = None
+    shed_wait_ms: float | None = None
+    aging_s: float | None = None
+    preempt: bool | None = None
+
+    _KEYS = (
+        "default_class",
+        "depth_interactive",
+        "depth_standard",
+        "depth_batch",
+        "shed_wait_ms",
+        "aging_s",
+        "preempt",
+    )
+
+    @classmethod
+    def parse(cls, value: Any) -> "QosPolicy | None":
+        if value is None:
+            return None
+        if not isinstance(value, Mapping):
+            raise ValueError(
+                f"'qos' must be a mapping, got {type(value).__name__}"
+            )
+        unknown = set(value) - set(cls._KEYS)
+        if unknown:
+            raise ValueError(f"unknown qos keys: {sorted(unknown)}")
+        if not value:
+            raise ValueError("'qos' must set at least one knob")
+        default_class = value.get("default_class", "standard")
+        if default_class not in QOS_CLASSES:
+            raise ValueError(
+                f"qos default_class must be one of {QOS_CLASSES}, "
+                f"got {default_class!r}"
+            )
+        for key in ("depth_interactive", "depth_standard", "depth_batch"):
+            raw = value.get(key)
+            if raw is not None and (not isinstance(raw, int) or raw < 1):
+                raise ValueError(f"qos {key} must be an int >= 1")
+        for key in ("shed_wait_ms", "aging_s"):
+            raw = value.get(key)
+            if raw is not None and (
+                not isinstance(raw, (int, float)) or raw < 0
+            ):
+                raise ValueError(f"qos {key} must be a number >= 0")
+        preempt = value.get("preempt")
+        if preempt is not None and not isinstance(preempt, bool):
+            raise ValueError("qos preempt must be a bool")
+        return cls(
+            default_class=str(default_class),
+            depth_interactive=value.get("depth_interactive"),
+            depth_standard=value.get("depth_standard"),
+            depth_batch=value.get("depth_batch"),
+            shed_wait_ms=(
+                float(value["shed_wait_ms"])
+                if value.get("shed_wait_ms") is not None
+                else None
+            ),
+            aging_s=(
+                float(value["aging_s"])
+                if value.get("aging_s") is not None
+                else None
+            ),
+            preempt=preempt,
+        )
+
+    def as_env(self) -> dict[str, str]:
+        """Set knobs as ``DORA_QOS_*`` suffix -> value strings (the
+        daemon injects these before the node's own env, so descriptor
+        ``env:`` entries can still override)."""
+        out = {"DEFAULT_CLASS": self.default_class}
+        if self.depth_interactive is not None:
+            out["DEPTH_INTERACTIVE"] = str(self.depth_interactive)
+        if self.depth_standard is not None:
+            out["DEPTH_STANDARD"] = str(self.depth_standard)
+        if self.depth_batch is not None:
+            out["DEPTH_BATCH"] = str(self.depth_batch)
+        if self.shed_wait_ms is not None:
+            out["SHED_WAIT_MS"] = str(self.shed_wait_ms)
+        if self.aging_s is not None:
+            out["AGING_S"] = str(self.aging_s)
+        if self.preempt is not None:
+            out["PREEMPT"] = "1" if self.preempt else "0"
+        return out
+
+
 @dataclass(frozen=True)
 class CustomNode:
     """A node that is its own executable (or a dynamic/externally-attached
@@ -302,6 +406,7 @@ class ResolvedNode:
     kind: CustomNode | RuntimeNode
     restart: RestartPolicy | None = None
     slo: SloPolicy | None = None
+    qos: QosPolicy | None = None
 
     @property
     def inputs(self) -> dict[DataId, Input]:
@@ -493,6 +598,7 @@ class Descriptor:
             kind=kind,
             restart=RestartPolicy.parse(value.get("restart")),
             slo=SloPolicy.parse(value.get("slo")),
+            qos=QosPolicy.parse(value.get("qos")),
         )
 
     # -- queries ------------------------------------------------------------

@@ -161,6 +161,31 @@ _SLO = {
     "additionalProperties": False,
 }
 
+_QOS = {
+    "description": (
+        "Traffic shaping for a serving node: request priority classes "
+        "(interactive/standard/batch) with weighted aged admission, "
+        "bounded per-class queue depths, a queue-wait shed deadline, "
+        "and preemption of lower-class decodes by page eviction. At "
+        "least one knob must be set."
+    ),
+    "type": "object",
+    "properties": {
+        "default_class": {
+            "type": "string",
+            "enum": ["interactive", "standard", "batch"],
+        },
+        "depth_interactive": {"type": "integer", "minimum": 1},
+        "depth_standard": {"type": "integer", "minimum": 1},
+        "depth_batch": {"type": "integer", "minimum": 1},
+        "shed_wait_ms": {"type": "number", "minimum": 0},
+        "aging_s": {"type": "number", "minimum": 0},
+        "preempt": {"type": "boolean"},
+    },
+    "minProperties": 1,
+    "additionalProperties": False,
+}
+
 _NODE = {
     "type": "object",
     "properties": {
@@ -172,6 +197,7 @@ _NODE = {
         "_unstable_deploy": {"$ref": "#/definitions/deploy"},
         "restart": {"$ref": "#/definitions/restart"},
         "slo": {"$ref": "#/definitions/slo"},
+        "qos": {"$ref": "#/definitions/qos"},
         # node kinds (exactly one)
         "path": {
             "type": "string",
@@ -268,6 +294,7 @@ def descriptor_schema() -> dict[str, Any]:
             "deploy": _DEPLOY,
             "restart": _RESTART,
             "slo": _SLO,
+            "qos": _QOS,
             "communication": _COMMUNICATION,
         },
     }

@@ -117,6 +117,9 @@ async def spawn_node(
     if node.slo is not None:
         for key, target in node.slo.as_targets().items():
             env[f"DORA_SLO_{key.upper()}"] = str(target)
+    if node.qos is not None:
+        for key, val in node.qos.as_env().items():
+            env[f"DORA_QOS_{key}"] = val
     env.update({str(k): str(v) for k, v in node.env.items()})
     env[NODE_CONFIG_ENV] = encode_node_config(node_config)
     # Nodes importing dora_tpu from a source checkout need the repo root.

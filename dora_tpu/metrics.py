@@ -183,6 +183,8 @@ class ServingMetrics:
         "checkpoints", "last_checkpoint_unix", "restored_streams",
         "migrated_out", "migrated_in",
         "spec_drafted", "spec_accepted", "spec_accept_len",
+        "shed", "preempted", "resumed", "qos_depth",
+        "autotune_k", "retunes",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -250,6 +252,19 @@ class ServingMetrics:
         #: distribution, reusing the octave buckets (values are token
         #: counts here, not µs)
         self.spec_accept_len = Histogram()
+        #: traffic shaping (QoS): requests shed on overload (bounded
+        #: class depth or queue-wait deadline -> retriable "overloaded"
+        #: chunk), streams evicted by page preemption, and preempted
+        #: streams re-admitted (recompute-on-resume)
+        self.shed = 0
+        self.preempted = 0
+        self.resumed = 0
+        #: per-class admission-queue depth gauge (set before snapshot)
+        self.qos_depth: dict[str, int] = {}
+        #: live fused-window K (gauge) and autotuner retunes applied —
+        #: 0 autotune_k means "engine exposes no window" (dense)
+        self.autotune_k = 0
+        self.retunes = 0
 
     def snapshot(self) -> dict:
         import time
@@ -300,6 +315,12 @@ class ServingMetrics:
                 else None
             ),
             "spec_accept_len": self.spec_accept_len.snapshot(),
+            "shed": self.shed,
+            "preempted": self.preempted,
+            "resumed": self.resumed,
+            "qos_depth": dict(self.qos_depth),
+            "autotune_k": self.autotune_k,
+            "retunes": self.retunes,
         }
 
 

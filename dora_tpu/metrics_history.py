@@ -106,14 +106,31 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
     for node, s in snap.get("serving", {}).items():
         for name in ("decode_tokens", "requests", "rejected",
                      "prefill_chunks", "host_dispatches", "compiles",
-                     "spec_drafted", "spec_accepted"):
+                     "spec_drafted", "spec_accepted",
+                     "shed", "preempted", "resumed", "retunes"):
             counters[f"srv:{node}:{name}"] = s.get(name, 0)
         for name in ("slots_active", "slots_total", "used_pages",
-                     "total_pages", "free_pages", "backlog_depth"):
+                     "total_pages", "free_pages", "backlog_depth",
+                     "autotune_k"):
             gauges[f"srv:{node}:{name}"] = s.get(name, 0)
+        for cls, d in (s.get("qos_depth") or {}).items():
+            gauges[f"srv:{node}:qos_depth:{cls}"] = d
         ttft = s.get("ttft_us") or {}
         hists[f"srv:{node}:ttft_us"] = list(ttft.get("counts", []))
     return counters, gauges, hists
+
+
+def burn_window_complete(n_samples: int, window_s: float,
+                         interval_s: float) -> bool:
+    """Does ``n_samples`` retained samples cover a full ``window_s``
+    burn window at ``interval_s`` cadence? Burn gauges computed over a
+    PARTIAL window are noisy (KNOWN_ISSUES round 9: a freshly started
+    dataflow reports burn over a 3-sample prefix) — consumers that act
+    on burn (the llm_server K autotuner) and the
+    ``dora_slo_burn_window_complete`` prom gauge gate on this."""
+    if interval_s <= 0:
+        return False
+    return n_samples >= max(1, round(window_s / interval_s))
 
 
 class MetricsHistoryRing:
@@ -288,6 +305,13 @@ class MetricsHistoryRing:
             for label, window_s in (("burn_1m", 60.0), ("burn_10m", 600.0)):
                 n = max(1, round(window_s / interval))
                 window = samples[-n:]
+                # Partial windows still report burn (over the prefix)
+                # but flag incompleteness so consumers — the autotuner,
+                # alerting off dora_slo_burn_rate — can ignore the
+                # noisy early gauges (KNOWN_ISSUES round 9).
+                entry[f"{label}_complete"] = burn_window_complete(
+                    len(window), window_s, interval
+                )
                 if not window:
                     entry[label] = 0.0
                     continue

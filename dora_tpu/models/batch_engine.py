@@ -392,7 +392,8 @@ class PagedBatchEngine:
     def __init__(self, *, init_pool, chunk_prefill, window_step,
                  max_slots: int = 16, max_seq: int, page_size: int,
                  chunk: int, num_pages: int, eos: int | None = None,
-                 window: int = 8, spec_k: int = 0, spec_ngram: int = 2):
+                 window: int = 8, spec_k: int = 0, spec_ngram: int = 2,
+                 window_factory=None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -447,7 +448,17 @@ class PagedBatchEngine:
         #: prompt + emissions, which the host already knows.
         self.spec_k = spec_k
         self.spec_ngram = spec_ngram
-        if spec_k:
+        #: configured speculation width — :meth:`set_window` can pause
+        #: speculation (spec_k -> 0) and resume it (spec_k -> _spec_cfg)
+        #: at window boundaries, so history mirrors and the admission
+        #: headroom follow the CONFIGURED width: pages stay reserved for
+        #: the verify tail while paused, making the toggle always safe.
+        self._spec_cfg = spec_k
+        #: ``(k, spec_k) -> window_step`` builder for runtime retuning
+        #: (the SLO autotuner); None pins the window program for life.
+        self._window_factory = window_factory
+        self._window_cache = {(window, spec_k): window_step}
+        if self._spec_cfg:
             self._hist_buf = max_seq + spec_k + 1
             self._hist: list[list[int]] = [[] for _ in range(max_slots)]
             self._hist_dev = jnp.zeros((max_slots, self._hist_buf), jnp.int32)
@@ -508,8 +519,11 @@ class PagedBatchEngine:
         admission math must reserve sequence room AND pages for the
         tail — the serial gate's contract (spec_decode.check_headroom),
         now in page units. 0 with speculation off, keeping the
-        admission math byte-identical to the pre-spec engine."""
-        return self.spec_k + 1 if self.spec_k else 0
+        admission math byte-identical to the pre-spec engine. Uses the
+        CONFIGURED width, not the live one: a stream admitted while the
+        autotuner has speculation paused must still own its verify-tail
+        pages for when speculation resumes."""
+        return self._spec_cfg + 1 if self._spec_cfg else 0
 
     def fits(self, prompt_len: int, max_new: int) -> bool:
         """Admissible EVER: length fits the block table and the whole
@@ -560,7 +574,7 @@ class PagedBatchEngine:
         self._decode[b] = False
         self._prefillq.append(b)
         self._bt_dirty = True
-        if self.spec_k:
+        if self._spec_cfg:
             self._hist[b] = list(ids)  # draft lookup sees the prompt too
         if self.serving_metrics is not None:
             g = self.serving_metrics.grant_pages
@@ -579,8 +593,83 @@ class PagedBatchEngine:
         self._decode[b] = False
         self._bt_dirty = True
         self._members_dirty = True
-        if self.spec_k:
+        if self._spec_cfg:
             self._hist[b] = []
+
+    # -- preemption / retuning (window-boundary only) ------------------------
+
+    def preempt(self, request_id: str) -> dict | None:
+        """Evict a live stream, freeing its slot and its whole page
+        grant (all-or-nothing grants make the victim's footprint exact).
+        Call between step()s — a window boundary, where host slots and
+        device vectors agree; the freed row's zeroed block table routes
+        any stale in-flight writes to the null page.
+
+        Returns ``{"emitted", "max_new", "pages", "was_decoding"}`` for
+        the caller's resume bookkeeping, or None if the id is not live.
+        The engine does NOT hold the victim's emitted token values —
+        the server does — so resume is a plain re-submit of
+        prompt + emitted with the remaining budget: chunked prefill is
+        deterministic, making the recomputed stream token-identical
+        (recompute-on-resume; no pool serialization on the hot path)."""
+        for b, s in enumerate(self.slots):
+            if s is not None and s.request_id == request_id:
+                break
+        else:
+            return None
+        if s.prompt is not None:
+            # Still prefilling: just drop it from the chunk queue.
+            try:
+                self._prefillq.remove(b)
+            except ValueError:
+                pass
+        meta = {
+            "emitted": s.emitted,
+            "max_new": s.max_new,
+            "pages": len(s.pages),
+            "was_decoding": bool(self._decode[b]),
+        }
+        self._free_slot(b)
+        if self.serving_metrics is not None:
+            self.serving_metrics.preempted += 1
+        if self.tracer is not None:
+            self.tracer.span(
+                "s_preempt", request_id,
+                f"slot={b} pages={meta['pages']} emitted={meta['emitted']}",
+            )
+        return meta
+
+    def set_window(self, k: int, *, spec_on: bool | None = None) -> bool:
+        """Re-select the fused-window K (and toggle speculation) at a
+        window boundary — the SLO autotuner's actuator. Requires the
+        ``window_factory`` closure (``(k, spec_k) -> window_step``);
+        programs are cached per (k, spec) so the ladder compiles each
+        rung once. Returns True when the program actually changed.
+
+        Safe mid-stream: the device-carried window state is per-stream
+        vectors independent of K, and ``_members_dirty`` forces a
+        rebuild for the spec <-> plain signature change. Greedy outputs
+        are identical at every K and spec setting, so retuning never
+        perturbs in-flight streams' tokens."""
+        assert k >= 1, k
+        if self._window_factory is None:
+            return False
+        if spec_on is None:
+            want_spec = self.spec_k
+        else:
+            want_spec = self._spec_cfg if spec_on else 0
+        if k == self.window and want_spec == self.spec_k:
+            return False
+        key = (k, want_spec)
+        fn = self._window_cache.get(key)
+        if fn is None:
+            fn = self._window_factory(k, want_spec)
+            self._window_cache[key] = fn
+        self.window_step = fn
+        self.window = k
+        self.spec_k = want_spec
+        self._members_dirty = True
+        return True
 
     # -- the interleaved step ------------------------------------------------
 
@@ -637,7 +726,7 @@ class PagedBatchEngine:
                     self._free_slot(b)
                 else:
                     self._decode[b] = True
-                    if self.spec_k:
+                    if self._spec_cfg:
                         self._hist[b].append(token)
                     self.tokens, self.positions = self._set_slot(
                         self.tokens, self.positions,
@@ -779,6 +868,11 @@ class PagedBatchEngine:
                         if token < 0:
                             break
                         slot.emitted += 1
+                        if self._spec_cfg:
+                            # Speculation is paused, not absent: keep the
+                            # host history mirror current so resuming it
+                            # rebuilds warm draft lookup state.
+                            self._hist[b].append(token)
                         done = (
                             slot.emitted >= slot.max_new
                             or (self.eos is not None and token == self.eos)
@@ -861,7 +955,7 @@ class PagedBatchEngine:
                 "last_token": int(toks[b]),
                 "position": int(pos[b]),
             }
-            if self.spec_k:
+            if self._spec_cfg:
                 # Draft-lookup history (prompt + emissions). Output
                 # identity does NOT depend on it — verification makes
                 # the emitted tokens exact whatever the drafts — but
@@ -926,7 +1020,7 @@ class PagedBatchEngine:
                 chunk_base=meta["chunk_base"],
             )
             self._decode[b] = True
-            if self.spec_k:
+            if self._spec_cfg:
                 # A snapshot from a spec-off engine (or an older build)
                 # carries no history: seed with the last token — the
                 # lookup's fallback draft — which keeps resumes legal
@@ -1034,26 +1128,28 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         del positions, bts
         return rule(tokens), pools
 
-    if spec_k:
-        def spec_step_fn(chunks, pools, positions, bts):
-            del positions, bts
-            return rule(chunks), pools
+    def spec_step_fn(chunks, pools, positions, bts):
+        del positions, bts
+        return rule(chunks), pools
 
-        base_window = jax.jit(
-            make_paged_spec_window(
-                spec_step_fn, k=window, spec_k=spec_k, ngram=spec_ngram,
-                eos=eos,
+    def window_factory(k, sk):
+        if sk:
+            base = jax.jit(
+                make_paged_spec_window(
+                    spec_step_fn, k=k, spec_k=sk, ngram=spec_ngram, eos=eos,
+                )
             )
-        )
-    else:
-        base_window = jax.jit(make_paged_window(step_fn, k=window, eos=eos))
+        else:
+            base = jax.jit(make_paged_window(step_fn, k=k, eos=eos))
 
-    def window_step(*args):
-        out = base_window(*args)
-        if tick_sleep_s:
-            jax.block_until_ready(out[0])
-            time.sleep(tick_sleep_s * window)
-        return out
+        def window_step(*args):
+            out = base(*args)
+            if tick_sleep_s:
+                jax.block_until_ready(out[0])
+                time.sleep(tick_sleep_s * k)
+            return out
+
+        return window_step
 
     chunk_fn = jax.jit(
         lambda ids, pools, position, bt: (rule(ids), pools)
@@ -1062,7 +1158,8 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
     return PagedBatchEngine(
         init_pool=lambda n: {"null": jnp.zeros((1,), jnp.int32)},
         chunk_prefill=chunk_fn,
-        window_step=window_step,
+        window_step=window_factory(window, spec_k),
+        window_factory=window_factory,
         max_slots=max_slots,
         max_seq=max_seq,
         page_size=page_size,

@@ -390,30 +390,35 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         spec_k = int(os.environ.get("DORA_SPEC_K", "0"))
     if spec_ngram is None:
         spec_ngram = int(os.environ.get("DORA_SPEC_NGRAM", "2"))
-    if spec_k:
-        window_fn = jax.jit(
-            _vlm.make_paged_spec_window(
-                lambda chunks, pools, positions, bts: fused_paged_spec_step(
-                    params, cfg, chunks, pools, positions, bts
+    def window_factory(k, sk):
+        # (k, spec) -> jitted window program; PagedBatchEngine caches
+        # built programs so the autotuner's ladder compiles each rung
+        # once per process.
+        if sk:
+            return jax.jit(
+                _vlm.make_paged_spec_window(
+                    lambda chunks, pools, positions, bts: fused_paged_spec_step(
+                        params, cfg, chunks, pools, positions, bts
+                    ),
+                    k=k,
+                    spec_k=sk,
+                    ngram=spec_ngram,
+                    eos=eos,
                 ),
-                k=window,
-                spec_k=spec_k,
-                ngram=spec_ngram,
-                eos=eos,
-            ),
-            donate_argnums=(1,),
-        )
-    else:
-        window_fn = jax.jit(
+                donate_argnums=(1,),
+            )
+        return jax.jit(
             _vlm.make_paged_window(
                 lambda tokens, pools, positions, bts: fused_paged_batch_step(
                     params, cfg, tokens, pools, positions, bts
                 ),
-                k=window,
+                k=k,
                 eos=eos,
             ),
             donate_argnums=(1,),
         )
+
+    window_fn = window_factory(window, spec_k)
     chunk_fn = jax.jit(
         lambda ids, pools, position, bt: fused_paged_chunk_step(
             params, cfg, ids, pools, position, bt
@@ -424,6 +429,7 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         init_pool=lambda n: init_page_pool(cfg, n, page_size),
         chunk_prefill=chunk_fn,
         window_step=window_fn,
+        window_factory=window_factory,
         window=window,
         max_slots=max_slots,
         max_seq=cfg.max_seq,

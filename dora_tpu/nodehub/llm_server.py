@@ -44,6 +44,22 @@ k tokens per tick via prompt-lookup and verifies them in the same
 dispatch — up to K·(k+1) tokens per round trip, greedy-exact — with
 DORA_SPEC_NGRAM (default 2) the lookup ngram width.
 
+Traffic shaping (descriptor ``qos:`` block -> DORA_QOS_* env):
+requests carry a priority class (``interactive``/``standard``/
+``batch``, wire metadata ``qos_class``, default
+DORA_QOS_DEFAULT_CLASS) and optionally a queue-wait ``deadline_ms``;
+admission drains classes by aged weight (DORA_QOS_AGING_S) so batch
+never starves; DORA_QOS_DEPTH_{INTERACTIVE,STANDARD,BATCH} bound the
+per-class backlog and DORA_QOS_SHED_WAIT_MS bounds queue wait — both
+shed with a retriable ``overloaded`` chunk (+retry_after_ms) instead
+of growing the backlog; DORA_QOS_PREEMPT=1 lets a blocked higher-class
+request evict a lower-class decode (page grant freed whole; the victim
+re-admits later and resumes token-identically by re-prefilling
+prompt+emitted). DORA_AUTOTUNE_K=1 adds the SLO-driven window
+autotuner (DORA_AUTOTUNE_INTERVAL_S / _LADDER / _HYSTERESIS /
+_BURN_WINDOW_S): TTFT burn or shedding steps K down a rung and pauses
+speculation, saturated decode-heavy windows step it back up.
+
 Serving metrics (slots, free pages, backlog, decode tokens/s, TTFT
 histogram) ship to the daemon every second and surface in
 ``dora-tpu metrics [--watch]``.
@@ -99,60 +115,213 @@ def make_engine(params, cfg, eos=None):
     )
 
 
+#: QoS priority classes, highest first. Weights are drain-order scores,
+#: not shares: the scheduler admits the class whose HEAD has the top
+#: score, where aging multiplies a head's weight by
+#: ``1 + waited / aging_s`` — a parked ``batch`` head overtakes a fresh
+#: ``interactive`` one after ``(8/1 - 1) * aging_s`` seconds, so batch
+#: never starves forever but never jumps a live interactive burst.
+QOS_CLASSES = ("interactive", "standard", "batch")
+QOS_WEIGHTS = {"interactive": 8.0, "standard": 4.0, "batch": 1.0}
+
+
+class QosConfig:
+    """Traffic-shaping knobs, from the descriptor ``qos:`` block (the
+    daemon injects it as ``DORA_QOS_*`` env at spawn; descriptor
+    ``env:`` entries override). All bounds optional: unset = the
+    pre-QoS behavior (single-class FIFO, never shed, never preempt)."""
+
+    __slots__ = ("default_class", "depths", "shed_wait_s", "aging_s",
+                 "preempt_on")
+
+    def __init__(self, *, default_class="standard", depths=None,
+                 shed_wait_s=None, aging_s=10.0, preempt_on=False):
+        assert default_class in QOS_CLASSES, default_class
+        self.default_class = default_class
+        #: per-class parked-entry bound (None = unbounded)
+        self.depths: dict[str, int | None] = {
+            c: (depths or {}).get(c) for c in QOS_CLASSES
+        }
+        #: queue-wait shed deadline, seconds (None = wait forever)
+        self.shed_wait_s = shed_wait_s
+        #: aging time constant, seconds (0/None disables aging)
+        self.aging_s = aging_s
+        self.preempt_on = preempt_on
+
+    @classmethod
+    def from_env(cls) -> "QosConfig":
+        def _f(key):
+            raw = os.environ.get(key, "")
+            try:
+                return float(raw) if raw else None
+            except ValueError:
+                return None
+
+        def _i(key):
+            v = _f(key)
+            return int(v) if v is not None else None
+
+        default = os.environ.get("DORA_QOS_DEFAULT_CLASS", "standard")
+        if default not in QOS_CLASSES:
+            default = "standard"
+        shed_ms = _f("DORA_QOS_SHED_WAIT_MS")
+        aging = _f("DORA_QOS_AGING_S")
+        return cls(
+            default_class=default,
+            depths={
+                "interactive": _i("DORA_QOS_DEPTH_INTERACTIVE"),
+                "standard": _i("DORA_QOS_DEPTH_STANDARD"),
+                "batch": _i("DORA_QOS_DEPTH_BATCH"),
+            },
+            shed_wait_s=shed_ms / 1000.0 if shed_ms is not None else None,
+            aging_s=aging if aging is not None else 10.0,
+            preempt_on=os.environ.get("DORA_QOS_PREEMPT", "") == "1",
+        )
+
+
 class AdmissionQueue:
-    """FIFO backlog in front of a serving engine.
+    """Per-class weighted backlog in front of a serving engine.
 
     Only ``fits()``-admissible requests ever enter (the caller rejects
-    never-admissible ones up front), so the head can always eventually
-    start once capacity frees. :meth:`drain` must run at EVERY point
-    capacity may have appeared — after a push, after an engine step
-    freed slots/pages, and on the idle path — a parked request must
-    never wait for unrelated traffic to trigger its admission
-    (regression: tests/test_llm_backlog.py).
+    never-admissible ones up front), so every head can eventually start
+    once capacity frees. :meth:`drain` must run at EVERY point capacity
+    may have appeared — after a push, after an engine step freed
+    slots/pages, and on the idle path — a parked request must never
+    wait for unrelated traffic to trigger its admission (regression:
+    tests/test_llm_backlog.py).
+
+    Scheduling: each drain iteration admits the class whose HEAD entry
+    scores highest (class weight aged by wait time, see QOS_WEIGHTS);
+    within a class, FIFO. With every entry in one class this IS the old
+    FIFO queue. There is deliberately no cross-class bypass: a small
+    ``batch`` request never slips past a blocked ``interactive`` head —
+    that's what preemption is for.
+
+    Overload turns into signals instead of unbounded backlog:
+    ``on_shed(key, reason, waited_s)`` fires when a push overflows its
+    class depth bound or a parked entry exceeds the queue-wait deadline
+    (config ``shed_wait_s``, tightened per-request by ``deadline_s``).
+    ``preempt(cls)`` (optional) is consulted when the best head cannot
+    be admitted: return True after evicting a lower-class victim (and
+    re-parking it via :meth:`requeue`) to make drain re-score and
+    retry; return False to leave the head parked.
 
     ``on_admit(key, waited_s)`` (optional) fires just before a parked
     request starts, with how long it sat in the backlog — the server
     feeds the ``backlog_wait`` histogram and the ``queued`` lifecycle
     span from it."""
 
-    def __init__(self, engine, start, on_admit=None, clock=time.monotonic):
+    def __init__(self, engine, start, on_admit=None, clock=time.monotonic,
+                 qos: QosConfig | None = None, on_shed=None, preempt=None):
         self._engine = engine
         self._start = start
         self._on_admit = on_admit
         self._clock = clock
-        self._q: list[tuple[str, list[int], int, float]] = []
+        self._qos = qos or QosConfig()
+        self._on_shed = on_shed
+        self._preempt = preempt
+        #: class -> [[key, ids, max_new, t_in, deadline_s], ...] FIFO
+        self._q: dict[str, list[list]] = {c: [] for c in QOS_CLASSES}
 
     def __len__(self) -> int:
-        return len(self._q)
+        return sum(len(q) for q in self._q.values())
+
+    def depths(self) -> dict[str, int]:
+        """Per-class parked depth (the qos_depth gauges)."""
+        return {c: len(q) for c, q in self._q.items()}
 
     def queued(self, key: str) -> bool:
         """Is ``key`` still parked (pushed but not yet admitted)?"""
-        return any(entry[0] == key for entry in self._q)
+        return any(
+            entry[0] == key for q in self._q.values() for entry in q
+        )
 
-    def push(self, key: str, ids: list[int], max_new: int) -> None:
-        self._q.append((key, ids, max_new, self._clock()))
+    def push(self, key: str, ids: list[int], max_new: int,
+             qos: str | None = None, deadline_s: float | None = None) -> bool:
+        """Park (then drain). Returns False when the entry was shed at
+        the door because its class queue is at its depth bound."""
+        cls = qos if qos in QOS_CLASSES else self._qos.default_class
+        cap = self._qos.depths.get(cls)
+        if cap is not None and len(self._q[cls]) >= cap:
+            if self._on_shed is not None:
+                self._on_shed(key, f"depth:{cls}", 0.0)
+            return False
+        self._q[cls].append([key, ids, max_new, self._clock(), deadline_s])
         self.drain()
+        return True
+
+    def requeue(self, key: str, ids: list[int], max_new: int,
+                qos: str | None = None) -> None:
+        """Park a preempted stream at the FRONT of its class, wait clock
+        reset (aging credit is forfeited — a re-aged victim outscoring
+        its preemptor would ping-pong the slot). No drain: only called
+        from inside the preempt hook, mid-drain."""
+        cls = qos if qos in QOS_CLASSES else self._qos.default_class
+        self._q[cls].insert(0, [key, ids, max_new, self._clock(), None])
+
+    def _shed_expired(self) -> None:
+        if self._on_shed is None:
+            return
+        now = self._clock()
+        for q in self._q.values():
+            kept = []
+            for entry in q:
+                limit = self._qos.shed_wait_s
+                if entry[4] is not None:
+                    limit = entry[4] if limit is None else min(limit, entry[4])
+                waited = now - entry[3]
+                if limit is not None and waited > limit:
+                    self._on_shed(entry[0], "queue_wait", waited)
+                else:
+                    kept.append(entry)
+            q[:] = kept
+
+    def _best(self, now: float) -> str | None:
+        best_cls, best_score = None, -1.0
+        for cls in QOS_CLASSES:
+            q = self._q[cls]
+            if not q:
+                continue
+            score = QOS_WEIGHTS[cls]
+            if self._qos.aging_s:
+                score *= 1.0 + (now - q[0][3]) / self._qos.aging_s
+            if score > best_score:
+                best_cls, best_score = cls, score
+        return best_cls
 
     def drain(self) -> None:
-        while self._q and self._engine.can_admit(
-            len(self._q[0][1]), self._q[0][2]
-        ):
-            key, ids, max_new, t_in = self._q.pop(0)
+        self._shed_expired()
+        while True:
+            now = self._clock()
+            cls = self._best(now)
+            if cls is None:
+                return
+            key, ids, max_new, t_in, _dl = self._q[cls][0]
+            if not self._engine.can_admit(len(ids), max_new):
+                if self._preempt is not None and self._preempt(cls):
+                    continue  # a victim was evicted: re-score and retry
+                return
+            self._q[cls].pop(0)
             if self._on_admit is not None:
-                self._on_admit(key, self._clock() - t_in)
+                self._on_admit(key, now - t_in)
             self._start(key, ids, max_new)
 
-    def pending(self) -> list[tuple[str, list[int], int]]:
-        """Parked requests, in order — serialized into checkpoints and
-        migration handoffs (the wait-start time is process-local and
-        deliberately dropped)."""
-        return [(k, list(ids), mn) for k, ids, mn, _ in self._q]
+    def pending(self) -> list[tuple[str, list[int], int, str]]:
+        """Parked requests in class-priority order — serialized into
+        checkpoints and migration handoffs (the wait-start time and
+        deadline are process-local and deliberately dropped)."""
+        return [
+            (k, list(ids), mn, cls)
+            for cls in QOS_CLASSES
+            for k, ids, mn, _t, _dl in self._q[cls]
+        ]
 
-    def take_all(self) -> list[tuple[str, list[int], int]]:
+    def take_all(self) -> list[tuple[str, list[int], int, str]]:
         """Drain the backlog without starting anything (migrate-out:
         parked requests travel with the live streams)."""
         out = self.pending()
-        self._q.clear()
+        for q in self._q.values():
+            q.clear()
         return out
 
 
@@ -183,10 +352,31 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
     while True:
         if on_tick is not None and on_tick():
             break
-        # Active decode: poll only (the engine must keep stepping);
-        # idle: park in recv (bounded — recv returns None on timeout,
-        # so the idle path below still runs a few times a second).
-        event = node.recv(timeout=0.0 if engine.active else 0.25)
+        # Drain a BURST of pending events before the next window (the
+        # first recv parks when the engine is idle; the rest only
+        # poll). One recv per step would cap intake at one request per
+        # dispatch — under an arrival burst the overload then queues
+        # UPSTREAM of the admission plane, where QoS classes, queue
+        # deadlines and preemption cannot see it (regression: the
+        # --qos-soak bench leg read zero sheds at 2x overload). The
+        # bound keeps a flood from starving the decode loop itself.
+        event = None
+        stop = False
+        for burst in range(128):
+            event = node.recv(
+                timeout=0.0 if engine.active or burst else 0.25
+            )
+            if event is None:
+                break
+            if event["type"] == "STOP":
+                stop = True
+                break
+            if event["type"] == "INPUT":
+                handle_input(event)
+            elif event["type"] == "MIGRATE" and handle_migrate is not None:
+                handle_migrate(event)
+        if stop:
+            break
         if (
             event is None
             and node.stream_ended
@@ -198,13 +388,6 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
             # Stream closed but handoffs may still arrive: don't spin
             # (recv returns immediately once the queue is closed).
             time.sleep(0.05)
-        if event is not None:
-            if event["type"] == "STOP":
-                break
-            if event["type"] == "INPUT":
-                handle_input(event)
-            elif event["type"] == "MIGRATE" and handle_migrate is not None:
-                handle_migrate(event)
         if engine.active:
             now = clock()
             if last_step_end is not None:
@@ -279,6 +462,20 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     slo_tok_s = _slo_env("DORA_SLO_TOKENS_PER_S_MIN")
     slo_queue = _slo_env("DORA_SLO_QUEUE_DEPTH_MAX")
     slo_prev: dict = {"t": None, "tokens": 0, "ttft": []}
+    # Traffic shaping (descriptor qos: block -> DORA_QOS_* env).
+    # Preemption needs the engine surface (preempt + per-slot request
+    # ids) — the dense engine silently serves without it.
+    qos = QosConfig.from_env()
+    can_preempt = qos.preempt_on and hasattr(engine, "preempt")
+    #: per-request QoS bookkeeping. req_prompt/req_emitted (token ids)
+    #: exist so a preempted stream can resume by re-prefilling
+    #: prompt + emitted — only tracked while preemption is on.
+    req_class: dict[str, str] = {}
+    req_prompt: dict[str, list[int]] = {}
+    req_emitted: dict[str, list[int]] = {}
+    admit_seq: dict[str, int] = {}
+    admit_counter = [0]
+    preempted_keys: set[str] = set()
     #: engine key -> wire request_id. The ENGINE key is always unique
     #: (req-N): two in-flight requests carrying the same wire
     #: ``request_id`` must not share a slot key, or their token streams
@@ -299,14 +496,27 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     #: the restored engine is already running.
     seen_rids: dict[str, None] = {}
 
+    def _forget(key: str) -> None:
+        req_class.pop(key, None)
+        req_prompt.pop(key, None)
+        req_emitted.pop(key, None)
+        admit_seq.pop(key, None)
+        preempted_keys.discard(key)
+
     def emit_text(
-        key: str, text: str, done: bool, finish: str | None = None
+        key: str, text: str, done: bool, finish: str | None = None,
+        extra: dict | None = None,
     ) -> None:
         meta: dict = {"done": bool(done)}
         if done:
             # Done-by-EOS ("stop") vs done-by-cap ("length"): the server
-            # reports this as the OpenAI finish_reason.
+            # reports this as the OpenAI finish_reason. Capacity signals
+            # are retriable: "rejected" (could NEVER fit: pages needed
+            # vs pool size ride in the payload) and "overloaded" (could
+            # fit, shed under load; retry_after_ms rides along).
             meta["finish"] = finish or "stop"
+        if extra:
+            meta.update(extra)
         seq = seqs.get(key, 0)
         meta["seq"] = seq
         if done:
@@ -331,6 +541,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         node.send_output("response", pa.array([text]), meta)
         if done:
             wire_ids.pop(key, None)
+            _forget(key)
             tracer.finish(key, finish or "stop")
 
     def emit(key: str, token: int, done: bool) -> None:
@@ -338,6 +549,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         if done:
             finish = "stop" if (eos is not None and token == eos) else "length"
         metrics.decode_tokens += 1
+        if can_preempt and not done and key in req_emitted:
+            req_emitted[key].append(token)
         emit_text(key, decode_one(token), done, finish)
 
     def on_admit(key: str, waited_s: float) -> None:
@@ -347,14 +560,83 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         tracer.span("s_queued", key, dur_ns=int(waited_s * 1e9))
 
     def start(key: str, ids: list[int], max_new: int) -> None:
+        admit_counter[0] += 1
+        admit_seq[key] = admit_counter[0]
+        if key in preempted_keys:
+            # A preempted stream re-admitting: its prefill recomputes
+            # prompt + emitted, so everything it decodes from here is
+            # token-identical to the unpreempted run.
+            preempted_keys.discard(key)
+            metrics.resumed += 1
+            tracer.span("s_resume", key, f"recompute={len(ids)}")
         res = engine.submit(key, ids, max_new)
         if res is not None:  # dense engine: first token is synchronous
             emit(key, *res)
         # paged engine: submit queues the prefill; the first token is
         # emitted by a later step() when the final chunk lands.
 
+    def on_shed(key: str, reason: str, waited_s: float) -> None:
+        # Overload -> fast retriable signal, never unbounded backlog:
+        # the stream closes with finish "overloaded" and a retry hint
+        # (clients with backoff re-enter the front door fresh).
+        metrics.shed += 1
+        t_admitted.pop(key, None)  # a shed stream has no first token
+        tracer.instant("s_shed", key, f"{reason} waited={waited_s:.3f}s")
+        retry_ms = int(max(100.0, (qos.shed_wait_s or 1.0) * 1000.0))
+        emit_text(
+            key, "", True, finish="overloaded",
+            extra={"retry_after_ms": retry_ms},
+        )
+
+    def try_preempt(cls: str) -> bool:
+        """A ``cls`` head is blocked on capacity: evict ONE victim of a
+        strictly lower class (lowest class first, then youngest — the
+        cheapest recompute), park it for resume, and report whether
+        anything was freed. The queue re-scores and retries after True,
+        so multi-victim evictions happen one grant at a time."""
+        if not can_preempt:
+            return False
+        rank = QOS_CLASSES.index(cls)
+        victim, vkey = None, (-1, -1)
+        for s in engine.slots:
+            if s is None:
+                continue
+            k = s.request_id
+            r = QOS_CLASSES.index(req_class.get(k, qos.default_class))
+            if r <= rank:
+                continue  # only strictly lower classes are victims
+            if k not in req_prompt:
+                # No resume bookkeeping (e.g. a checkpoint-restored
+                # stream): evicting it could not be token-identical.
+                continue
+            cand = (r, admit_seq.get(k, 0))
+            if cand > vkey:
+                victim, vkey = k, cand
+        if victim is None:
+            return False
+        meta = engine.preempt(victim)
+        if meta is None:
+            return False
+        remaining = meta["max_new"] - meta["emitted"]
+        if remaining <= 0:
+            # Raced with completion; the slot is free either way.
+            emit_text(victim, "", True, finish="length")
+            return True
+        preempted_keys.add(victim)
+        backlog.requeue(
+            victim,
+            list(req_prompt.get(victim, [])) + list(req_emitted.get(victim, [])),
+            remaining,
+            req_class.get(victim),
+        )
+        return True
+
     #: requests that arrived while the engine couldn't admit them
-    backlog = AdmissionQueue(engine, start, on_admit=on_admit, clock=clock)
+    backlog = AdmissionQueue(
+        engine, start, on_admit=on_admit, clock=clock,
+        qos=qos, on_shed=on_shed,
+        preempt=try_preempt if can_preempt else None,
+    )
 
     def handle_input(event) -> None:
         from dora_tpu.telemetry import OTEL_CTX_KEY
@@ -390,6 +672,15 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             int(meta.get("max_new_tokens", max_new_cap)),
             max_new_cap,
         )
+        cls = meta.get("qos_class") or meta.get("priority")
+        if cls not in QOS_CLASSES:
+            cls = qos.default_class
+        try:
+            dl = float(meta.get("deadline_ms", "") or 0) / 1000.0
+        except (TypeError, ValueError):
+            dl = 0.0
+        deadline_s = dl if dl > 0 else None
+        req_class[key] = cls
         if max_new <= 0:
             # max_tokens <= 0 asks for nothing: close the stream
             # empty instead of fabricating a token.
@@ -397,21 +688,37 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             tracer.instant("s_reject", key, "max_new<=0")
             emit_text(key, "", True, finish="length")
         elif not engine.fits(len(ids), max_new):
-            # Oversized: close the stream empty — never fabricate a
-            # token as a "successful" answer.
+            # NEVER admissible: close the stream empty with a
+            # structured retriable "rejected" (distinct from the shed
+            # path's "overloaded" — retrying the same body cannot
+            # help, the payload says why: its page grant exceeds the
+            # whole pool / block table).
             metrics.rejected += 1
+            extra: dict = {"reject_reason": "oversized"}
+            if paged:
+                extra["pages_needed"] = engine.pages_needed(
+                    len(ids), max_new
+                )
+                extra["pool_pages"] = engine.allocator.num_pages - 1
+                extra["max_seq"] = engine.max_seq
             tracer.instant("s_reject", key, f"oversized len={len(ids)}")
-            emit_text(key, "", True, finish="length")
+            emit_text(key, "", True, finish="rejected", extra=extra)
         else:
             t_admitted[key] = clock()
-            backlog.push(key, ids, max_new)  # push drains: admits now
-            # when the engine can, else parks until capacity frees
+            if can_preempt:
+                req_prompt[key] = list(ids)
+                req_emitted[key] = []
+            if not backlog.push(key, ids, max_new, cls, deadline_s):
+                return  # shed at the door (class depth bound)
+            # push drains: admits now when the engine can, else parks
+            # until capacity frees
             if backlog.queued(key):
                 # Parked: no slot, or the page pool couldn't cover the
-                # grant — the preempt-free backlog wait begins here.
+                # grant — the backlog wait (or a preemption) begins
+                # here.
                 tracer.instant(
                     "s_page_wait", key,
-                    f"backlog={len(backlog)} "
+                    f"qos={cls} backlog={len(backlog)} "
                     f"free_pages={getattr(engine, 'free_pages', 0)}",
                 )
 
@@ -458,6 +765,122 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         slo_prev["tokens"] = toks
         slo_prev["ttft"] = counts
 
+    # ------------------------------------------------------------------
+    # SLO-driven K autotuner (DORA_AUTOTUNE_K=1): a slow control loop
+    # re-selecting the fused-window K from live signals. TTFT burn
+    # (interval p99 over the DORA_SLO_TTFT_P99_MS target) or shedding
+    # steps K DOWN one ladder rung and pauses speculation — shorter
+    # windows mean finer admission boundaries and faster first tokens;
+    # a saturated window (tokens/dispatch >= 3/4 of K) with no burn
+    # steps K UP and resumes speculation — decode-heavy mixes drift
+    # toward K=16 (BENCHMARKS round 10). Hysteresis: a signal must hold
+    # for DORA_AUTOTUNE_HYSTERESIS consecutive intervals, and after a
+    # retune the loop cools down as many intervals (change-rate cap:
+    # at most one rung per hysteresis window). The loop never acts
+    # before its burn window has a full complement of samples
+    # (metrics_history.burn_window_complete — a freshly started
+    # dataflow must not retune off a 3-sample "burn").
+    # ------------------------------------------------------------------
+    at_on = (
+        os.environ.get("DORA_AUTOTUNE_K", "") == "1"
+        and hasattr(engine, "set_window")
+        and getattr(engine, "_window_factory", None) is not None
+    )
+    at_interval = float(os.environ.get("DORA_AUTOTUNE_INTERVAL_S", "5") or 5)
+    at_hyst = max(1, int(os.environ.get("DORA_AUTOTUNE_HYSTERESIS", "2") or 2))
+    at_burn_win = float(
+        os.environ.get("DORA_AUTOTUNE_BURN_WINDOW_S", "60") or 60
+    )
+    _ladder_env = os.environ.get("DORA_AUTOTUNE_LADDER", "4,8,16")
+    try:
+        at_ladder = sorted(
+            {int(x) for x in _ladder_env.split(",") if int(x) >= 1}
+            | {getattr(engine, "window", 1)}
+        )
+    except ValueError:
+        at_ladder = sorted({4, 8, 16} | {getattr(engine, "window", 1)})
+    at_state = {
+        "t": None, "tokens": 0, "dispatches": 0, "ttft": [],
+        "samples": 0, "burn": 0, "calm": 0, "cooldown": 0,
+        "shed": 0,
+        "rung": at_ladder.index(getattr(engine, "window", at_ladder[0]))
+        if getattr(engine, "window", None) in at_ladder else 0,
+    }
+
+    def autotune(now: float) -> None:
+        if not at_on:
+            return
+        if at_state["t"] is None:
+            at_state["t"] = now
+            at_state["tokens"] = metrics.decode_tokens
+            at_state["dispatches"] = metrics.host_dispatches
+            at_state["ttft"] = list(metrics.ttft.counts)
+            at_state["shed"] = metrics.shed
+            return
+        if now - at_state["t"] < at_interval:
+            return
+        from dora_tpu.metrics_history import burn_window_complete
+
+        d_tok = metrics.decode_tokens - at_state["tokens"]
+        d_disp = metrics.host_dispatches - at_state["dispatches"]
+        d_shed = metrics.shed - at_state["shed"]
+        counts = list(metrics.ttft.counts)
+        d_ttft = [c - p for c, p in zip(counts, at_state["ttft"])]
+        at_state["t"] = now
+        at_state["tokens"] = metrics.decode_tokens
+        at_state["dispatches"] = metrics.host_dispatches
+        at_state["ttft"] = counts
+        at_state["shed"] = metrics.shed
+        at_state["samples"] += 1
+        burn = d_shed > 0
+        if slo_ttft_ms is not None and any(d > 0 for d in d_ttft):
+            p99 = percentile_from_counts(d_ttft, 99)
+            if p99 is not None and p99 > slo_ttft_ms * 1000.0:
+                burn = True
+        tpd = (d_tok / d_disp) if d_disp else 0.0
+        k_now = at_ladder[at_state["rung"]]
+        if burn:
+            at_state["burn"] += 1
+            at_state["calm"] = 0
+        elif d_disp and tpd >= 0.75 * k_now:
+            at_state["calm"] += 1
+            at_state["burn"] = 0
+        else:
+            at_state["burn"] = 0
+            at_state["calm"] = 0
+        if not burn_window_complete(
+            at_state["samples"], at_burn_win, at_interval
+        ):
+            return
+        if at_state["cooldown"] > 0:
+            at_state["cooldown"] -= 1
+            return
+        new_rung, spec_on, reason = None, None, ""
+        if at_state["burn"] >= at_hyst and at_state["rung"] > 0:
+            new_rung, spec_on = at_state["rung"] - 1, False
+            reason = "shed" if d_shed > 0 else "ttft_burn"
+        elif (
+            at_state["calm"] >= at_hyst
+            and at_state["rung"] < len(at_ladder) - 1
+        ):
+            new_rung, spec_on = at_state["rung"] + 1, True
+            reason = "decode_heavy"
+        if new_rung is None:
+            return
+        new_k = at_ladder[new_rung]
+        if not engine.set_window(new_k, spec_on=spec_on):
+            return
+        at_state["rung"] = new_rung
+        at_state["burn"] = at_state["calm"] = 0
+        at_state["cooldown"] = at_hyst
+        metrics.retunes += 1
+        metrics.autotune_k = new_k
+        tracer.instant(
+            "k_retune", "(engine)",
+            f"K {k_now}->{new_k} spec_k={engine.spec_k} "
+            f"reason={reason} tpd={tpd:.2f}",
+        )
+
     def report(now: float) -> None:
         metrics.slots_active = engine.active
         metrics.slots_total = engine.max_slots
@@ -476,7 +899,10 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 metrics.largest_contig_free = (
                     alloc.largest_contiguous_free()
                 )
+        metrics.qos_depth = backlog.depths()
+        metrics.autotune_k = getattr(engine, "window", 0)
         check_slo(now)
+        autotune(now)
         try:
             node.report_serving(metrics.snapshot())
         except Exception:
@@ -498,7 +924,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         state = {
             "engine": engine.checkpoint_state(),
             "backlog": [
-                [k, list(ids), mn] for k, ids, mn in backlog.pending()
+                [k, list(ids), mn, cls]
+                for k, ids, mn, cls in backlog.pending()
             ],
             "wire_ids": dict(wire_ids),
             "seqs": dict(seqs),
@@ -554,8 +981,11 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         for k, ctx in (saved.get("ctxs") or {}).items():
             tracer.begin(k, ctx or "")
         restored = engine.restore_state(saved.get("engine") or {"slots": []})
-        for k, ids, mn in saved.get("backlog") or []:
-            backlog.push(k, list(ids), int(mn))
+        for entry in saved.get("backlog") or []:
+            # Entries are [k, ids, max_new] pre-QoS, [.., class] after;
+            # the wait clock and any deadline restart on restore.
+            cls = entry[3] if len(entry) > 3 else None
+            backlog.push(entry[0], list(entry[1]), int(entry[2]), cls)
         metrics.restored_streams += len(restored)
         tracer.span(
             "s_restore", "(engine)", f"streams={len(restored)}",
@@ -576,10 +1006,12 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         state = engine.drain_streams()
         parked = backlog.take_all()
         keys = [m["request_id"] for m in state["slots"]]
-        keys += [k for k, _ids, _mn in parked]
+        keys += [entry[0] for entry in parked]
         payload = {
             "engine": state,
-            "backlog": [[k, list(ids), mn] for k, ids, mn in parked],
+            "backlog": [
+                [k, list(ids), mn, cls] for k, ids, mn, cls in parked
+            ],
             "wire_ids": {k: wire_ids.get(k) for k in keys},
             "seqs": {k: seqs.get(k, 0) for k in keys},
             "ctxs": {k: tracer.context(k) for k in keys},
@@ -602,6 +1034,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             wire_ids.pop(k, None)
             seqs.pop(k, None)
             t_admitted.pop(k, None)
+            _forget(k)
         metrics.migrated_out += len(keys)
 
     def _admit_handoff(payload: dict, src: str) -> None:
@@ -622,8 +1055,11 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         for m in state["slots"]:
             m["request_id"] = fresh(m["request_id"])
         parked = [
-            (fresh(k), list(ids), int(mn))
-            for k, ids, mn in payload.get("backlog") or []
+            (
+                fresh(entry[0]), list(entry[1]), int(entry[2]),
+                entry[3] if len(entry) > 3 else None,
+            )
+            for entry in payload.get("backlog") or []
         ]
         src_wire = payload.get("wire_ids") or {}
         src_seqs = payload.get("seqs") or {}
@@ -654,8 +1090,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 tracer.instant("s_reject", nk, f"migrate-in overflow {src}")
                 emit_text(nk, "", True, finish="error")
             return
-        for nk, ids, mn in parked:
-            backlog.push(nk, ids, mn)
+        for nk, ids, mn, cls in parked:
+            backlog.push(nk, ids, mn, cls)
         dur = int((clock() - t0) * 1e9)
         for nk in mapping.values():
             tracer.span("s_migrate_in", nk, f"from={src}", dur_ns=dur)

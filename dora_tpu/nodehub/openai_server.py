@@ -165,6 +165,27 @@ def main() -> None:
                 meta = {"request_id": rid}
                 if isinstance(body.get("max_tokens"), int):
                     meta["max_new_tokens"] = body["max_tokens"]
+                # Traffic shaping: the body wins over the header so a
+                # proxy-injected default never overrides an explicit
+                # request. Unknown class strings pass through — the
+                # responder folds them to its configured default.
+                qos = (
+                    body.get("qos_class")
+                    or body.get("priority")
+                    or self.headers.get("x-dora-qos")
+                )
+                if isinstance(qos, str) and qos:
+                    meta["qos_class"] = qos
+                deadline = body.get("deadline_ms")
+                if deadline is None:
+                    try:
+                        deadline = float(
+                            self.headers.get("x-dora-deadline-ms", "")
+                        )
+                    except ValueError:
+                        deadline = None
+                if isinstance(deadline, (int, float)) and deadline > 0:
+                    meta["deadline_ms"] = float(deadline)
                 with send_lock:  # send_output is not thread-safe
                     node.send_output("text", pa.array([text]), meta)
                 if stream:
@@ -173,9 +194,12 @@ def main() -> None:
                 parts: list[str] = []
                 finished = False
                 finish_reason = None  # responder's tag: "stop" | "length"
+                extra: dict = {}  # shed/reject detail (retry_after_ms, ...)
                 while True:
                     try:
-                        delta, done, finish = chunks.get(timeout=timeout_s)
+                        delta, done, finish, extra = chunks.get(
+                            timeout=timeout_s
+                        )
                     except queue.Empty:
                         if not stream:
                             # Stalled mid-answer: a truncated completion
@@ -206,8 +230,32 @@ def main() -> None:
                         finish=(finish_reason or "stop")
                         if finished
                         else "length",
+                        extra=extra or None,
                     )
                     self.wfile.write(b"data: [DONE]\n\n")
+                elif finished and not parts and finish_reason in (
+                    "overloaded", "rejected"
+                ):
+                    # Shed (retriable, 429 + Retry-After) or structurally
+                    # impossible (400) — a 200 with empty content would
+                    # hide the backpressure from every standard client.
+                    retry_ms = extra.get("retry_after_ms")
+                    headers = (
+                        {"Retry-After": str(max(1, int(retry_ms / 1000.0)))}
+                        if retry_ms
+                        else None
+                    )
+                    self._json(
+                        {
+                            "error": {
+                                "message": f"request {finish_reason}",
+                                "type": finish_reason,
+                                **({"dora": extra} if extra else {}),
+                            }
+                        },
+                        status=429 if finish_reason == "overloaded" else 400,
+                        headers=headers,
+                    )
                 else:
                     self._json(
                         {
@@ -238,7 +286,8 @@ def main() -> None:
             self.send_header("Cache-Control", "no-cache")
             self.end_headers()
 
-        def _sse_chunk(self, model: str, delta: dict, finish=None):
+        def _sse_chunk(self, model: str, delta: dict, finish=None,
+                       extra: dict | None = None):
             payload = {
                 "id": "chatcmpl-dora-tpu",
                 "object": "chat.completion.chunk",
@@ -248,14 +297,21 @@ def main() -> None:
                     {"index": 0, "delta": delta, "finish_reason": finish}
                 ],
             }
+            if extra:
+                # Shed/reject detail (retry_after_ms, pages_needed, ...)
+                # rides in a vendor key — OpenAI clients ignore it.
+                payload["dora"] = extra
             self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
             self.wfile.flush()
 
-        def _json(self, payload: dict):
+        def _json(self, payload: dict, status: int = 200,
+                  headers: dict | None = None):
             data = json.dumps(payload).encode()
-            self.send_response(200)
+            self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for key, val in (headers or {}).items():
+                self.send_header(key, val)
             self.end_headers()
             self.wfile.write(data)
 
@@ -293,8 +349,15 @@ def main() -> None:
                 with routed_lock:
                     target = routed.get(rid)
                 if target is not None:  # client gone: drop silently
+                    extra = {
+                        k: meta[k]
+                        for k in ("retry_after_ms", "reject_reason",
+                                  "pages_needed", "pool_pages", "max_seq")
+                        if meta.get(k) is not None
+                    }
                     target.put(
-                        (answer, bool(meta.get("done")), meta.get("finish"))
+                        (answer, bool(meta.get("done")),
+                         meta.get("finish"), extra)
                     )
                 continue
             responses.put(answer)

@@ -52,6 +52,13 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_serving_ttft_us": ("gauge", "Time-to-first-token percentiles"),
     "dora_slo_burn_rate": ("gauge", "Fraction of the SLO error budget consumed over the window"),
     "dora_slo_violations_total": ("counter", "SLO-violating history samples per node"),
+    "dora_slo_burn_window_complete": ("gauge", "1 when the burn window holds a full complement of samples (partial-window burn is noisy)"),
+    "dora_serving_shed_total": ("counter", "Requests shed on overload (depth bound / queue-wait deadline)"),
+    "dora_serving_preempted_total": ("counter", "Streams evicted by QoS page preemption"),
+    "dora_serving_resumed_total": ("counter", "Preempted streams re-admitted (recompute-on-resume)"),
+    "dora_serving_retunes_total": ("counter", "Fused-window K retunes applied by the SLO autotuner"),
+    "dora_serving_qos_depth": ("gauge", "Admission-backlog depth per QoS class"),
+    "dora_serving_autotune_k": ("gauge", "Live fused-window K (decode ticks per dispatch)"),
 }
 
 #: (snapshot serving key, metric family) pairs for the per-node scalars
@@ -62,6 +69,10 @@ _SERVING_COUNTERS = (
     ("prefill_chunks", "dora_serving_prefill_chunks_total"),
     ("host_dispatches", "dora_serving_host_dispatches_total"),
     ("compiles", "dora_serving_compiles_total"),
+    ("shed", "dora_serving_shed_total"),
+    ("preempted", "dora_serving_preempted_total"),
+    ("resumed", "dora_serving_resumed_total"),
+    ("retunes", "dora_serving_retunes_total"),
 )
 _SERVING_GAUGES = (
     ("slots_active", "dora_serving_slots_active"),
@@ -70,6 +81,7 @@ _SERVING_GAUGES = (
     ("free_pages", "dora_serving_free_pages"),
     ("total_pages", "dora_serving_total_pages"),
     ("backlog_depth", "dora_serving_backlog_depth"),
+    ("autotune_k", "dora_serving_autotune_k"),
 )
 
 
@@ -113,6 +125,12 @@ def iter_samples(
                 yield family, labels, s.get(key, 0) or 0
             for key, family in _SERVING_GAUGES:
                 yield family, labels, s.get(key, 0) or 0
+            for cls, depth in (s.get("qos_depth") or {}).items():
+                yield (
+                    "dora_serving_qos_depth",
+                    {**labels, "class": cls},
+                    depth or 0,
+                )
             ttft = s.get("ttft_us") or {}
             for p in (50, 90, 99):
                 value = ttft.get(f"p{p}_us")
@@ -129,6 +147,11 @@ def iter_samples(
                     "dora_slo_burn_rate",
                     {**labels, "window": window},
                     entry.get(f"burn_{window}", 0.0),
+                )
+                yield (
+                    "dora_slo_burn_window_complete",
+                    {**labels, "window": window},
+                    1.0 if entry.get(f"burn_{window}_complete") else 0.0,
                 )
             yield "dora_slo_violations_total", labels, entry.get("violations", 0)
 
@@ -285,12 +308,18 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "prefill_chunks": 12,
                     "host_dispatches": 512,
                     "compiles": 7,
+                    "shed": 5,
+                    "preempted": 2,
+                    "resumed": 2,
+                    "retunes": 1,
                     "slots_active": 3,
                     "slots_total": 4,
                     "used_pages": 48,
                     "free_pages": 16,
                     "total_pages": 64,
                     "backlog_depth": 1,
+                    "autotune_k": 8,
+                    "qos_depth": {"interactive": 0, "standard": 1, "batch": 3},
                     "ttft_us": hist.snapshot(),
                 }
             },
@@ -298,7 +327,9 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                 "llm": {
                     "targets": {"ttft_p99_ms": 50.0},
                     "burn_1m": 0.25,
+                    "burn_1m_complete": True,
                     "burn_10m": 0.05,
+                    "burn_10m_complete": False,
                     "violations": 3,
                 }
             },

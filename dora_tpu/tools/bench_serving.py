@@ -35,10 +35,18 @@ fused window (DORA_SPEC_K), spec_k in {0, 2, 4} x K in {1, 8} on the
 stub engine's repetitive (best-case acceptance) and random (worst-case)
 token rules — tokens per dispatch and acceptance rate per cell.
 
+A sixth axis behind ``--qos-soak``: open-loop Poisson mixed-class
+overload through the REAL serve() admission path (stub engine, no
+weights), QoS shaping on vs off over the identical arrival trace —
+per-class TTFT p50/p99, shed rate, preempt/resume counts. The
+acceptance headline is ``interactive_p99_on_vs_off`` < 1.0: shaping
+must buy the interactive class latency under overload, paid for by the
+batch class, never by silent loss (completion accounting rides along).
+
 Usage::
 
     python -m dora_tpu.tools.bench_serving [--multistep | --trace-ab |
-                                            --spec-ab]
+                                            --spec-ab | --qos-soak]
 """
 
 from __future__ import annotations
@@ -325,11 +333,186 @@ def _spec_ab() -> dict:
     return out
 
 
+class _OpenLoopNode:
+    """Node fake feeding serve() a pre-scheduled open-loop arrival
+    trace: recv() releases an event once its arrival time has passed —
+    the ARRIVALS don't slow down when the engine backs up, which is the
+    property that makes overload visible (a closed loop self-throttles
+    and hides it)."""
+
+    def __init__(self, schedule):
+        #: [(t_offset_s, event), ...] sorted by offset
+        self._schedule = list(schedule)
+        self._t0 = time.perf_counter()
+        self.stream_ended = False
+        self.sent: list[tuple[float, dict]] = []
+
+    def now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def recv(self, timeout=None):
+        if not self._schedule:
+            self.stream_ended = True
+            return None
+        if self.now() >= self._schedule[0][0]:
+            return self._schedule.pop(0)[1]
+        return None
+
+    def send_output(self, output_id, value, metadata=None):
+        self.sent.append((self.now(), dict(metadata or {})))
+
+    def report_serving(self, snapshot):
+        pass
+
+    def close(self):
+        pass
+
+
+def _qos_soak() -> dict:
+    """Mixed-class Poisson overload soak behind ``--qos-soak`` (see
+    module docstring). Identical seeded arrival trace both legs; the
+    off leg drops the class tags and the shaping env — the pre-QoS
+    single-class FIFO."""
+    import numpy as np
+
+    from dora_tpu.metrics import ServingMetrics
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+    from dora_tpu.nodehub.llm_server import serve
+
+    streams = int(os.environ.get("DORA_BENCH_QOS_STREAMS", "1200"))
+    max_new, tick_sleep = 8, 0.0008
+    # One prefill chunk per step bounds admission to ~1/window_wall
+    # streams/s; the arrival rate doubles it — a sustained overload.
+    rate = 2.0 / (4 * tick_sleep)
+    rng = np.random.default_rng(7)
+    gaps = rng.exponential(1.0 / rate, size=streams)
+    classes = rng.choice(
+        ["interactive", "standard", "batch"], size=streams,
+        p=[0.25, 0.35, 0.40],
+    )
+    arrivals = []
+    t = 0.0
+    for n in range(streams):
+        t += float(gaps[n])
+        arrivals.append((t, f"q{n}", str(classes[n])))
+
+    qos_env = {
+        "DORA_QOS_PREEMPT": "1",
+        "DORA_QOS_SHED_WAIT_MS": "1500",
+        "DORA_QOS_DEPTH_BATCH": "256",
+    }
+
+    def leg(shaped: bool) -> dict:
+        saved = {k: os.environ.pop(k, None) for k in qos_env}
+        if shaped:
+            os.environ.update(qos_env)
+        try:
+            engine = make_stub_paged_engine(
+                max_slots=8, max_seq=64, page_size=8, chunk=16,
+                window=4, tick_sleep_s=tick_sleep,
+            )
+            schedule = [
+                (at, {
+                    "type": "INPUT",
+                    "metadata": {
+                        "request_id": rid,
+                        "max_new_tokens": max_new,
+                        **({"qos_class": cls} if shaped else {}),
+                    },
+                    "value": f"prompt {rid}".encode(),
+                })
+                for at, rid, cls in arrivals
+            ]
+            node = _OpenLoopNode(schedule)
+            metrics = ServingMetrics(engine="paged")
+            t0 = time.perf_counter()
+            serve(
+                node, engine, metrics,
+                encode=lambda text: [ord(ch) % 97 + 1 for ch in text],
+                decode_one=lambda tok: f" t{tok}",
+                max_new_cap=max_new,
+            )
+            wall = time.perf_counter() - t0
+            by_rid: dict[str, dict] = {}
+            for ts, meta in node.sent:
+                rid = meta.get("request_id")
+                if rid is None:
+                    continue
+                s = by_rid.setdefault(rid, {"t0": ts, "finish": None})
+                if meta.get("done"):
+                    s["finish"] = meta.get("finish")
+            ttft: dict[str, list[float]] = {
+                "interactive": [], "standard": [], "batch": []
+            }
+            finishes: dict[str, int] = {}
+            for at, rid, cls in arrivals:
+                s = by_rid.get(rid)
+                assert s is not None and s["finish"], (
+                    f"stream {rid} silently lost"
+                )
+                finishes[s["finish"]] = finishes.get(s["finish"], 0) + 1
+                if s["finish"] in ("stop", "length"):
+                    ttft[cls].append(s["t0"] - at)
+
+            def pct(vals, q):
+                if not vals:
+                    return None
+                o = sorted(vals)
+                return round(
+                    o[min(len(o) - 1, int(len(o) * q))] * 1e3, 1
+                )
+
+            return {
+                "wall_s": round(wall, 2),
+                "finishes": finishes,
+                "shed": metrics.shed,
+                "preempted": metrics.preempted,
+                "resumed": metrics.resumed,
+                "ttft_ms": {
+                    cls: {
+                        "n": len(vals),
+                        "p50": pct(vals, 0.50),
+                        "p99": pct(vals, 0.99),
+                    }
+                    for cls, vals in ttft.items()
+                },
+            }
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    on, off = leg(shaped=True), leg(shaped=False)
+    # Off leg is single-class: slice its TTFTs by the class the SAME
+    # rid carried in the on leg — the A/B compares the same requests.
+    p99_on = on["ttft_ms"]["interactive"]["p99"]
+    p99_off = off["ttft_ms"]["interactive"]["p99"]
+    return {
+        "streams": streams,
+        "arrival_rate_per_s": round(rate, 1),
+        "max_new": max_new,
+        "qos_on": on,
+        "qos_off": off,
+        "interactive_p99_on_vs_off": (
+            round(p99_on / p99_off, 3)
+            if p99_on is not None and p99_off
+            else None
+        ),
+    }
+
+
 def main() -> int:
     import numpy as np
 
     from dora_tpu.models.hf import qwen2
 
+    if "--qos-soak" in sys.argv[1:]:
+        # Stub-engine leg: the QoS machinery is engine-agnostic, the
+        # soak measures the ADMISSION plane, not the model.
+        print(json.dumps({"qos_soak": _qos_soak()}))
+        return 0
     if "--spec-ab" in sys.argv[1:]:
         # Stub-engine leg: no checkpoint needed, acceptance is shaped
         # by the token rule, not model weights.

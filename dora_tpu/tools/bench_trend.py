@@ -40,6 +40,9 @@ WATCHED: dict[str, str] = {
     "p50_us_1kib.daemon": "lower",
     "p99_us_1kib.daemon": "lower",
     "e2e_fps": "higher",
+    # Traffic-shaping soak: the on/off interactive TTFT p99 ratio —
+    # a drift toward 1.0 means shaping stopped buying latency.
+    "serving_qos_soak.interactive_p99_on_vs_off": "lower",
 }
 
 #: flag when a watched metric is worse than the previous run by more

@@ -64,6 +64,13 @@ SERVING_SPAN_KINDS = {
     "s_restore": "restore",
     "s_migrate_out": "migrate_out",
     "s_migrate_in": "migrate_in",
+    # Traffic shaping: a lower-class stream evicted by page preemption
+    # (its grant freed for a higher-class request) and its later
+    # re-admission (recompute-on-resume). Both carry the stream's trace
+    # context, so a preempted request shows one contiguous chain:
+    # … decode_window → preempt → queued → resume → prefill_chunk …
+    "s_preempt": "preempt",
+    "s_resume": "resume",
 }
 
 #: Hot-path flight events surfaced as instants (everything else recorded
@@ -80,11 +87,14 @@ INSTANT_NAMES = {
     "replay_inputs": "replay inputs",
     "daemon_reconnect": "daemon reconnect",
     "slo_violation": "SLO violation",
+    "s_shed": "load shed",
+    "k_retune": "window retune",
 }
 
 #: Instants that belong on the engine track and may carry a request
 #: trace context in ``b`` (linked into the lifecycle chain by args).
-_ENGINE_INSTANTS = {"s_reject", "s_page_wait", "xla_compile"}
+_ENGINE_INSTANTS = {"s_reject", "s_page_wait", "xla_compile", "s_shed",
+                    "k_retune"}
 
 #: Chrome-trace tid of the serving-engine track within a process (tid 0
 #: is the message plane).
@@ -378,8 +388,12 @@ def _sample_snapshots() -> list[dict]:
                 [43, base + 9_300_000, "s_prefill_chunk", "req-1 base=0", rctx, 200_000],
                 [44, base + 9_800_000, "s_decode_window", "req-1 k=8 n=5", rctx, 400_000],
                 [45, base + 9_850_000, "xla_compile", "window", None, 3_000_000],
+                [48, base + 9_860_000, "s_preempt", "req-1 pages=2", rctx, 0],
+                [49, base + 9_880_000, "s_resume", "req-1 emitted=5", rctx, 0],
                 [46, base + 9_900_000, "s_finish", "req-1 stop", rctx, 0],
                 [47, base + 9_950_000, "s_reject", "req-2 length", None, None],
+                [50, base + 9_960_000, "s_shed", "req-4 queue_wait", None, None],
+                [51, base + 9_970_000, "k_retune", "K 8->4 spec=0", None, None],
             ],
         },
         "dropped_events": {"llm": 23},
@@ -424,7 +438,8 @@ def self_check() -> list[str]:
         if ev["ph"] == "X" and ev.get("cat") == "serving"
     ]
     chain = [ev["name"].split(" ", 1)[0] for ev in engine_spans]
-    want = ["queued", "admitted", "prefill_chunk", "decode_window", "finish"]
+    want = ["queued", "admitted", "prefill_chunk", "decode_window",
+            "preempt", "resume", "finish"]
     if chain != want:
         errors.append(f"lifecycle chain broken: {chain}")
     if any(ev.get("args", {}).get("trace_id") not in ids for ev in engine_spans):

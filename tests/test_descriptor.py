@@ -333,6 +333,64 @@ class TestSlo:
             parse(y)
 
 
+class TestQos:
+    def test_parse_and_env(self):
+        d = parse(
+            "nodes: [{id: a, path: p, "
+            "qos: {default_class: interactive, depth_batch: 4, "
+            "shed_wait_ms: 1500, aging_s: 5, preempt: true}}]"
+        )
+        q = d.nodes[0].qos
+        assert q.default_class == "interactive"
+        assert q.depth_batch == 4 and q.depth_interactive is None
+        assert q.shed_wait_ms == 1500.0
+        assert q.aging_s == 5.0
+        assert q.preempt is True
+        env = q.as_env()
+        assert env["DEFAULT_CLASS"] == "interactive"
+        assert env["DEPTH_BATCH"] == "4"
+        assert env["SHED_WAIT_MS"] == "1500.0"
+        assert env["PREEMPT"] == "1"
+        assert "DEPTH_INTERACTIVE" not in env
+
+    def test_absent_is_none(self):
+        assert parse("nodes: [{id: a, path: p}]").nodes[0].qos is None
+
+    @pytest.mark.parametrize(
+        "y,match",
+        [
+            ("nodes: [{id: a, path: p, qos: 5}]", "must be a mapping"),
+            (
+                "nodes: [{id: a, path: p, qos: {}}]",
+                "at least one knob",
+            ),
+            (
+                "nodes: [{id: a, path: p, qos: {bogus: 1}}]",
+                "unknown qos keys",
+            ),
+            (
+                "nodes: [{id: a, path: p, qos: {default_class: vip}}]",
+                "default_class must be one of",
+            ),
+            (
+                "nodes: [{id: a, path: p, qos: {depth_batch: 0}}]",
+                "must be an int >= 1",
+            ),
+            (
+                "nodes: [{id: a, path: p, qos: {shed_wait_ms: -1}}]",
+                "must be a number >= 0",
+            ),
+            (
+                "nodes: [{id: a, path: p, qos: {preempt: 1}}]",
+                "must be a bool",
+            ),
+        ],
+    )
+    def test_rejected(self, y, match):
+        with pytest.raises(ValueError, match=match):
+            parse(y)
+
+
 def test_mermaid_output():
     d = parse(VLM_YAML)
     mermaid = d.visualize_as_mermaid()

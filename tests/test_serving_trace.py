@@ -210,9 +210,17 @@ def test_rejects_record_instants_not_spans(tracing_on):
     assert "s_admitted" not in kinds
     assert metrics.rejected == 2 and metrics.requests == 2
     # Both streams still answered: one empty done chunk each.
-    dones = [m for _, _, m in node.sent if m.get("done")]
+    # max_new<=0 closes as "length" (the request asked for nothing);
+    # the oversized prompt gets the structured retriable "rejected"
+    # with the sizing detail a client needs to split the request.
+    dones = {m.get("request_id"): m for _, _, m in node.sent if m.get("done")}
     assert len(dones) == 2
-    assert all(m.get("finish") == "length" for m in dones)
+    assert dones["wire-zero"]["finish"] == "length"
+    over = dones["wire-" + "x" * 200]
+    assert over["finish"] == "rejected"
+    assert over["reject_reason"] == "oversized"
+    assert over["pages_needed"] > over["pool_pages"] or \
+        200 + 4 > over["max_seq"]
 
 
 def test_ttft_not_quantized_to_the_decode_window():
