@@ -658,6 +658,44 @@ def serving_qos_soak() -> dict:
     return data
 
 
+def serving_prefix_ab() -> dict:
+    """Shared-prefix cache A/B (tools/bench_serving --prefix-ab): a
+    Zipf-popular template workload replayed open-loop on the stub paged
+    engine, prefix cache on vs off over the identical arrival trace.
+    Headline: ``hit_p50_on_vs_off`` <= 0.5 — a cache hit must at least
+    halve hit-request TTFT vs the same requests uncached (the serving
+    default-on gate); hit rate, prefill-chunk deltas, and eviction
+    counts ride along. Fresh subprocess for the same accelerator-claim
+    reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--prefix-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "prefix_ab" in row:
+            data = row["prefix_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "cache_on": None,
+            "cache_off": None,
+            "hit_p50_on_vs_off": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -860,6 +898,16 @@ def main() -> int:
         }
 
     try:
+        prefix_ab = serving_prefix_ab()
+    except Exception as exc:
+        prefix_ab = {
+            "cache_on": None,
+            "cache_off": None,
+            "hit_p50_on_vs_off": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -897,6 +945,7 @@ def main() -> int:
         "serving_trace_ab": trace_ab,
         "serving_spec_ab": spec_ab,
         "serving_qos_soak": qos_soak,
+        "serving_prefix_ab": prefix_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

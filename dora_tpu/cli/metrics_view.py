@@ -250,6 +250,35 @@ def render_metrics(
                 qos_rows,
             )
 
+    # Shared-prefix cache plane: hit rate, cached/shared page footprint,
+    # COW boundary copies, evictions. Only appears once the cache has
+    # seen traffic — cache-off engines and old snapshots stay clean.
+    if serving:
+        prefix_rows = []
+        for nid in sorted(serving):
+            s = serving[nid]
+            lookups = s.get("prefix_hits", 0) + s.get("prefix_misses", 0)
+            if not lookups and not s.get("prefix_cached_pages"):
+                continue
+            rate = s.get("prefix_hit_rate")
+            prefix_rows.append([
+                nid,
+                f"{rate * 100:.0f}%" if rate is not None else "-",
+                str(s.get("prefix_hits", 0)),
+                str(s.get("prefix_misses", 0)),
+                str(s.get("prefix_hit_tokens", 0)),
+                str(s.get("prefix_cached_pages", 0)),
+                str(s.get("prefix_shared_pages", 0)),
+                str(s.get("prefix_cow_copies", 0)),
+                str(s.get("prefix_evictions", 0)),
+            ])
+        if prefix_rows:
+            lines += [""] + _table(
+                ["PREFIX", "HIT%", "HITS", "MISS", "HIT TOK", "CACHED",
+                 "SHARED", "COW", "EVICT"],
+                prefix_rows,
+            )
+
     # Elastic-recovery plane: daemon-side respawn/replay counters merge
     # with serving-side checkpoint/migration counters by node id. The
     # table only appears once something recovered — steady state stays

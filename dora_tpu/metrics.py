@@ -185,6 +185,9 @@ class ServingMetrics:
         "spec_drafted", "spec_accepted", "spec_accept_len",
         "shed", "preempted", "resumed", "qos_depth",
         "autotune_k", "retunes",
+        "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+        "prefix_cached_pages", "prefix_shared_pages",
+        "prefix_cow_copies", "prefix_evictions",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -265,6 +268,20 @@ class ServingMetrics:
         #: 0 autotune_k means "engine exposes no window" (dense)
         self.autotune_k = 0
         self.retunes = 0
+        #: shared-prefix KV cache (paged engine, DORA_PREFIX_CACHE):
+        #: admission lookups that mapped cached pages (hits) vs cold
+        #: prefills (misses), tokens served from cache, pages the radix
+        #: cache holds / currently mapped shared into live streams
+        #: (gauges), copy-on-write boundary pages re-materialized, and
+        #: cached pages evicted back to the pool under admission
+        #: pressure
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_cached_pages = 0
+        self.prefix_shared_pages = 0
+        self.prefix_cow_copies = 0
+        self.prefix_evictions = 0
 
     def snapshot(self) -> dict:
         import time
@@ -321,6 +338,22 @@ class ServingMetrics:
             "qos_depth": dict(self.qos_depth),
             "autotune_k": self.autotune_k,
             "retunes": self.retunes,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_rate": (
+                round(
+                    self.prefix_hits
+                    / (self.prefix_hits + self.prefix_misses),
+                    4,
+                )
+                if (self.prefix_hits + self.prefix_misses)
+                else None
+            ),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_cached_pages": self.prefix_cached_pages,
+            "prefix_shared_pages": self.prefix_shared_pages,
+            "prefix_cow_copies": self.prefix_cow_copies,
+            "prefix_evictions": self.prefix_evictions,
         }
 
 

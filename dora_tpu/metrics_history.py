@@ -107,11 +107,14 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
         for name in ("decode_tokens", "requests", "rejected",
                      "prefill_chunks", "host_dispatches", "compiles",
                      "spec_drafted", "spec_accepted",
-                     "shed", "preempted", "resumed", "retunes"):
+                     "shed", "preempted", "resumed", "retunes",
+                     "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+                     "prefix_cow_copies", "prefix_evictions"):
             counters[f"srv:{node}:{name}"] = s.get(name, 0)
         for name in ("slots_active", "slots_total", "used_pages",
                      "total_pages", "free_pages", "backlog_depth",
-                     "autotune_k"):
+                     "autotune_k", "prefix_cached_pages",
+                     "prefix_shared_pages"):
             gauges[f"srv:{node}:{name}"] = s.get(name, 0)
         for cls, d in (s.get("qos_depth") or {}).items():
             gauges[f"srv:{node}:qos_depth:{cls}"] = d

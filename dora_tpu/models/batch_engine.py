@@ -254,19 +254,38 @@ class BatchEngine:
 
 
 class PageAllocator:
-    """Fixed-pool block allocator over page-size KV blocks.
+    """Fixed-pool block allocator over page-size KV blocks, with
+    per-page refcounts so pages can be SHARED across block tables.
 
     Physical page 0 is RESERVED as the null page: a zeroed block-table
     entry points there, so masked/idle rows of the batched kernels dump
     their harmless writes into it instead of a live slot's context.
     Allocation is all-or-nothing (``alloc`` returns None rather than a
     partial grant) — admission is page-aware up front, so a admitted
-    stream can never OOM mid-decode (the preempt-free watermark)."""
+    stream can never OOM mid-decode (the preempt-free watermark).
+
+    Refcounts are the custody model behind the prefix cache
+    (models/prefix_cache.py): a page granted by ``alloc``/``take``
+    starts at refcount 1; every additional holder — a second stream's
+    block table mapping the same prefix page, or the prefix cache
+    itself — calls :meth:`ref`, and releases with :meth:`unref`. The
+    page returns to the free list only when the LAST holder lets go.
+    Shared pages (refcount > 1) are immutable by convention: the paged
+    engine only ever maps a shared page into block-table positions the
+    stream never writes (divergent rows get fresh pages — the
+    copy-on-write boundary is re-materialized, never written in place).
+
+    :meth:`free` keeps the legacy exclusive-release contract and is now
+    HARDENED: freeing a page that is not allocated (double free) or
+    that another holder still references (free-while-shared) raises
+    instead of silently corrupting the free list."""
 
     def __init__(self, num_pages: int):
         assert num_pages >= 2, num_pages
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))
+        #: page id -> refcount; only pages with refcount >= 1 appear
+        self._ref: dict[int, int] = {}
         #: high-water mark of pages in use (telemetry: a pool sized to
         #: peak_in_use + headroom is the capacity-planning answer)
         self.peak_in_use = 0
@@ -302,6 +321,8 @@ class PageAllocator:
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
         if self.in_use > self.peak_in_use:
             self.peak_in_use = self.in_use
         return pages
@@ -309,18 +330,92 @@ class PageAllocator:
     def take(self, pages: list[int]) -> bool:
         """Claim SPECIFIC page ids — checkpoint restore, where saved
         block tables reference physical ids. All-or-nothing like
-        :meth:`alloc`; O(pool), restore-path only."""
+        :meth:`alloc`; O(pool), restore-path only. A page another
+        holder already references cannot be taken (the restore path
+        :meth:`ref`-shares those instead)."""
         free = set(self._free)
         if len(set(pages)) != len(pages) or not all(p in free for p in pages):
             return False
         claim = set(pages)
         self._free = [p for p in self._free if p not in claim]
+        for p in pages:
+            self._ref[p] = 1
         if self.in_use > self.peak_in_use:
             self.peak_in_use = self.in_use
         return True
 
+    def refcount(self, page: int) -> int:
+        """Current holder count for one page (0 = free)."""
+        return self._ref.get(page, 0)
+
+    def ref(self, pages: list[int]) -> None:
+        """Add one reference per page — a new holder of already-granted
+        pages (prefix sharing). Raises on pages nobody holds: sharing a
+        free page would let the allocator grant it again underneath the
+        new holder."""
+        for p in pages:
+            rc = self._ref.get(p, 0)
+            if rc <= 0:
+                raise RuntimeError(
+                    f"cannot ref page {p}: not allocated (refcount 0)"
+                )
+            self._ref[p] = rc + 1
+
+    def unref(self, pages: list[int]) -> None:
+        """Drop one reference per page; a page returns to the free list
+        when its LAST reference drops. Raises on double free (the page
+        is already free)."""
+        for p in pages:
+            rc = self._ref.get(p, 0)
+            if rc <= 0:
+                raise RuntimeError(
+                    f"double free: page {p} is not allocated"
+                )
+            if rc == 1:
+                del self._ref[p]
+                self._free.append(p)
+            else:
+                self._ref[p] = rc - 1
+
     def free(self, pages: list[int]) -> None:
-        self._free.extend(pages)
+        """Exclusive release: the caller asserts it is the SOLE holder.
+        Raises on double free AND on free-while-shared — both silently
+        corrupted the free list before refcounting (a shared page would
+        land on the free list while another block table still pointed
+        at it). Holders that may share pages release with
+        :meth:`unref` instead."""
+        for p in pages:
+            rc = self._ref.get(p, 0)
+            if rc <= 0:
+                raise RuntimeError(
+                    f"double free: page {p} is not allocated"
+                )
+            if rc > 1:
+                raise RuntimeError(
+                    f"free of shared page {p} (refcount {rc}); "
+                    f"shared holders release via unref"
+                )
+        self.unref(pages)
+
+    def check_invariants(self) -> None:
+        """Every page is exactly one of {null, free, refcounted}, the
+        free list holds no duplicates, refcounts are >= 1, and
+        ``in_use + free == total - 1``. Cheap enough to assert after
+        every chaos/migration test (O(pool))."""
+        free = self._free
+        assert len(set(free)) == len(free), "duplicate pages in free list"
+        assert all(0 < p < self.num_pages for p in free), \
+            "free list holds out-of-range or null page ids"
+        assert all(rc >= 1 for rc in self._ref.values()), \
+            "zero/negative refcount retained"
+        assert all(0 < p < self.num_pages for p in self._ref), \
+            "refcounted out-of-range or null page"
+        assert set(free).isdisjoint(self._ref), \
+            "page both free and refcounted"
+        assert len(free) + len(self._ref) == self.num_pages - 1, (
+            f"page accounting broken: {len(free)} free + "
+            f"{len(self._ref)} in use != {self.num_pages - 1}"
+        )
 
 
 @dataclass
@@ -332,6 +427,9 @@ class _PagedSlot:
     prompt: list[int] | None  # pending prompt ids; None once decoding
     true_len: int
     chunk_base: int = 0
+    #: leading pages of ``pages`` mapped SHARED from the prefix cache
+    #: (refcounted, immutable); the stream's own writes start past them
+    shared: int = 0
 
 
 class PagedBatchEngine:
@@ -393,7 +491,8 @@ class PagedBatchEngine:
                  max_slots: int = 16, max_seq: int, page_size: int,
                  chunk: int, num_pages: int, eos: int | None = None,
                  window: int = 8, spec_k: int = 0, spec_ngram: int = 2,
-                 window_factory=None):
+                 window_factory=None, prefix_cache: bool = False,
+                 prefix_cache_pages: int = 0):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -416,6 +515,19 @@ class PagedBatchEngine:
         self.max_pages = max_seq // page_size
         self.pools = init_pool(num_pages)
         self.allocator = PageAllocator(num_pages)
+        #: shared-prefix subsystem (models/prefix_cache.py): radix
+        #: lookup at admission maps cached prefix pages straight into
+        #: the new stream's block table and prefill starts at the
+        #: divergence point. Off (None) by default at the raw-engine
+        #: level — serving factories enable it via DORA_PREFIX_CACHE.
+        if prefix_cache:
+            from dora_tpu.models.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                self.allocator, page_size, max_pages=prefix_cache_pages,
+            )
+        else:
+            self.prefix_cache = None
         # Host-side block tables (the scheduler's source of truth) plus
         # a device DECODE view with non-decoding rows zeroed: a slot
         # mid-prefill holds real pages, and letting its masked decode
@@ -535,19 +647,30 @@ class PagedBatchEngine:
             <= self.allocator.num_pages - 1
         )
 
-    def pages_needed(self, prompt_len: int, max_new: int) -> int:
+    def pages_needed(self, prompt_len: int, max_new: int,
+                     cached: int = 0) -> int:
         """Pages a stream can touch end to end: chunk-padded prefill
         writes (whole pages) vs prompt + max_new decode rows (+ the
-        speculative verification tail), whichever reaches further."""
-        chunk_rows = -(-prompt_len // self.chunk) * self.chunk
+        speculative verification tail), whichever reaches further.
+        With ``cached`` tokens mapped from the prefix cache, prefill
+        restarts at the (page-aligned) divergence point, so its write
+        reach is ``cached`` plus the chunk-padded remainder — the
+        result still COUNTS the shared pages (total footprint; the
+        fresh grant is ``pages_needed - cached // page_size``)."""
+        chunk_rows = cached + -(-(prompt_len - cached) // self.chunk) * self.chunk
         rows = max(chunk_rows, prompt_len + max_new + self.spec_headroom())
         return -(-rows // self.page_size)
 
     def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        avail = self.free_pages
+        if self.prefix_cache is not None:
+            # Eviction yields to admission: unpinned, unshared cached
+            # pages are free-in-waiting, never a reason to shed.
+            avail += self.prefix_cache.evictable_pages()
         return (
             self.free_slots > 0
             and self.fits(prompt_len, max_new)
-            and self.pages_needed(prompt_len, max_new) <= self.free_pages
+            and self.pages_needed(prompt_len, max_new) <= avail
         )
 
     def submit(self, request_id: str, prompt_ids, max_new: int) -> None:
@@ -564,12 +687,27 @@ class PagedBatchEngine:
                 f"({len(ids)}+{max_new}, max_seq {self.max_seq})"
             )
         b = self.slots.index(None)
-        pages = self.allocator.alloc(self.pages_needed(len(ids), max_new))
+        base0, shared = (0, [])
+        if self.prefix_cache is not None:
+            base0, shared = self._prefix_grant(ids, max_new)
+        need = self.pages_needed(len(ids), max_new, base0) - len(shared)
+        if need > self.allocator.free_pages and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.allocator.free_pages)
+        fresh = self.allocator.alloc(need)
+        if fresh is None:
+            if shared:
+                self.allocator.unref(shared)
+            raise RuntimeError(
+                f"cannot admit {request_id!r}: page pool exhausted "
+                f"({need} fresh needed, {self.free_pages} free)"
+            )
+        pages = shared + fresh
         self._bt[b, :] = 0
         self._bt[b, : len(pages)] = pages
         self.slots[b] = _PagedSlot(
             request_id, emitted=0, max_new=max_new, pages=pages,
-            prompt=ids, true_len=len(ids),
+            prompt=ids, true_len=len(ids), chunk_base=base0,
+            shared=len(shared),
         )
         self._decode[b] = False
         self._prefillq.append(b)
@@ -580,14 +718,77 @@ class PagedBatchEngine:
             g = self.serving_metrics.grant_pages
             g[len(pages)] = g.get(len(pages), 0) + 1
         if self.tracer is not None:
+            if base0:
+                self.tracer.span(
+                    "s_prefix_hit", request_id,
+                    f"tokens={base0}/{len(ids)} pages={len(shared)}",
+                )
             self.tracer.span(
                 "s_admitted", request_id,
-                f"slot={b} pages={len(pages)}",
+                f"slot={b} pages={len(pages)}"
+                + (f" shared={len(shared)}" if shared else ""),
             )
         return None
 
+    def _prefix_grant(self, ids: list[int], max_new: int
+                      ) -> tuple[int, list[int]]:
+        """Longest usable cached prefix for a new prompt: looks up the
+        radix cache, trims the match so (a) at least the final prompt
+        token is re-prefilled (the first generated token comes off the
+        divergence chunk's logits), (b) the chunk-padded write reach
+        stays inside the block table, and (c) the fresh-page need fits
+        free + evictable pages (sharing must never turn an admissible
+        request inadmissible). Refs the shared pages into this stream's
+        custody and returns ``(divergence_base, shared_page_ids)``.
+
+        Trimmed boundary pages are re-materialized privately by the
+        divergence chunk — the copy-on-write boundary copy (the copy
+        and the divergent write fuse into one chunk pass, so shared
+        pages are never written in place)."""
+        ps = self.page_size
+        cache = self.prefix_cache
+        matched, pages, mid_page = cache.lookup(ids)
+        cap = (len(ids) - 1) // ps * ps
+        lo = min(matched, cap)
+        while lo and (
+            lo + -(-(len(ids) - lo) // self.chunk) * self.chunk
+            > self.max_seq
+        ):
+            lo -= ps
+        shared = pages[: lo // ps]
+        if shared:
+            self.allocator.ref(shared)
+        # Sharing consumes evictable pages without shrinking the fresh
+        # need below the no-cache grant in every geometry (the chunk
+        # overhang past a non-chunk-aligned divergence can cost one
+        # extra page) — back off page by page until the grant this
+        # admission was promised still fits. lo == 0 always fits:
+        # can_admit checked the no-cache grant against free+evictable.
+        while shared:
+            need = self.pages_needed(len(ids), max_new, lo) - len(shared)
+            if need <= self.allocator.free_pages + cache.evictable_pages():
+                break
+            self.allocator.unref([shared.pop()])
+            lo -= ps
+        if not shared:
+            lo = 0
+        if lo:
+            cache.hits += 1
+            cache.hit_tokens += lo
+        else:
+            cache.misses += 1
+        # Boundary pages the cache held but this stream re-materializes
+        # privately: a divergence mid-page, or a match trimmed by the
+        # final-token / reach / capacity rules above.
+        if matched > lo or mid_page:
+            cache.cow_copies += 1
+        return lo, shared
+
     def _free_slot(self, b: int) -> None:
-        self.allocator.free(self.slots[b].pages)
+        # unref, not free: leading pages may be shared with the prefix
+        # cache / other streams — the page pool reclaims each page only
+        # when its last holder lets go.
+        self.allocator.unref(self.slots[b].pages)
         self._bt[b, :] = 0
         self.slots[b] = None
         self._decode[b] = False
@@ -595,6 +796,51 @@ class PagedBatchEngine:
         self._members_dirty = True
         if self._spec_cfg:
             self._hist[b] = []
+
+    # -- prefix-cache custody / invariants -----------------------------------
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently mapped SHARED into live streams' block
+        tables (the prefix cache's own holdings are cached_pages)."""
+        return sum(s.shared for s in self.slots if s is not None)
+
+    def prefix_pin(self, ids) -> int:
+        """Pin the cached path for ``ids`` against eviction (a
+        preempted victim's prefix survives the wait to resume on
+        refcount custody, not slot custody). No-op without a cache."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.pin(ids)
+
+    def prefix_unpin(self, ids) -> None:
+        if self.prefix_cache is not None:
+            self.prefix_cache.unpin(ids)
+
+    def check_invariants(self) -> None:
+        """Allocator bookkeeping plus cross-custody: every allocated
+        page's refcount equals the number of holders that can name it
+        (live slots' grants + prefix-cache nodes), and nothing else
+        holds pages. Callable from tests after any chaos/migration
+        sequence."""
+        from collections import Counter
+
+        self.allocator.check_invariants()
+        held: Counter = Counter()
+        for s in self.slots:
+            if s is not None:
+                held.update(s.pages)
+        if self.prefix_cache is not None:
+            held.update(self.prefix_cache.pages())
+        for p, n in held.items():
+            rc = self.allocator.refcount(p)
+            assert rc == n, (
+                f"page {p}: refcount {rc} != {n} holders"
+            )
+        assert self.allocator.in_use == len(held), (
+            f"{self.allocator.in_use} pages in use but only "
+            f"{len(held)} held by slots/cache"
+        )
 
     # -- preemption / retuning (window-boundary only) ------------------------
 
@@ -706,6 +952,17 @@ class PagedBatchEngine:
             final_chunk = s.chunk_base >= s.true_len
             if final_chunk:  # final chunk: stream starts
                 self._prefillq.popleft()
+                if self.prefix_cache is not None:
+                    # The prompt's fully-populated pages are immutable
+                    # from here on (decode writes start at true_len,
+                    # past them): adopt them into the radix cache so
+                    # later prompts map them instead of re-prefilling.
+                    n_full = s.true_len // self.page_size
+                    if n_full:
+                        self.prefix_cache.insert(
+                            s.prompt[: n_full * self.page_size],
+                            s.pages[:n_full],
+                        )
                 s.prompt = None
                 # Host-index AFTER a full [C] fetch — a device gather at
                 # a python index would compile one slice per distinct
@@ -948,6 +1205,7 @@ class PagedBatchEngine:
                 "emitted": s.emitted,
                 "max_new": s.max_new,
                 "pages": [int(p) for p in s.pages],
+                "shared": s.shared,
                 "prompt": list(s.prompt) if s.prompt is not None else None,
                 "true_len": s.true_len,
                 "chunk_base": s.chunk_base,
@@ -980,6 +1238,12 @@ class PagedBatchEngine:
         jnp = self._jnp
         restored: list[str] = []
         metas = state.get("slots", [])
+        #: pages already claimed by an earlier slot of THIS restore —
+        #: prefix-shared pages appear in several slots' grants, so the
+        #: first slot takes them and later slots ref-share them (the
+        #: checkpoint is one engine's consistent snapshot; refcount
+        #: custody rebuilds exactly).
+        claimed: set[int] = set()
         # Decoding slots first: with pin_slots their index is fixed, and
         # a prefill re-submit must not claim it out from under them.
         for meta in sorted(metas, key=lambda m: not m.get("decode")):
@@ -991,11 +1255,16 @@ class PagedBatchEngine:
             if pin_slots:
                 b = meta["slot"]
                 pages = [int(p) for p in meta["pages"]]
-                if self.slots[b] is not None or not self.allocator.take(pages):
+                fresh = [p for p in pages if p not in claimed]
+                if self.slots[b] is not None or not self.allocator.take(fresh):
                     raise RuntimeError(
                         f"cannot restore stream {meta['request_id']!r}: "
                         f"slot {b} or its pages are busy"
                     )
+                reshared = [p for p in pages if p in claimed]
+                if reshared:
+                    self.allocator.ref(reshared)
+                claimed.update(pages)
             else:
                 if self.free_slots == 0:
                     raise RuntimeError(
@@ -1018,6 +1287,9 @@ class PagedBatchEngine:
                 prompt=None,
                 true_len=meta["true_len"],
                 chunk_base=meta["chunk_base"],
+                # Migrate-in re-grants fresh pages, so sharing does not
+                # survive the hop (pool contents are not shipped either).
+                shared=meta.get("shared", 0) if pin_slots else 0,
             )
             self._decode[b] = True
             if self._spec_cfg:
@@ -1079,7 +1351,10 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
                            eos: int | None = None, window: int = 1,
                            vocab: int = 97, tick_sleep_s: float = 0.0,
                            spec_k: int = 0, spec_ngram: int = 2,
-                           cycle: int | None = None):
+                           cycle: int | None = None,
+                           prefix_cache: bool = False,
+                           prefix_cache_pages: int = 0,
+                           chunk_sleep_s: float = 0.0):
     """A weight-free :class:`PagedBatchEngine` over the REAL window
     machinery: the decode window is ``vlm.make_paged_window`` (the same
     ``lax.scan`` + ``freeze_inactive`` program serving runs) with the
@@ -1151,9 +1426,19 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
 
         return window_step
 
-    chunk_fn = jax.jit(
+    chunk_jit = jax.jit(
         lambda ids, pools, position, bt: (rule(ids), pools)
     )
+    if chunk_sleep_s:
+        # Emulate per-chunk device cost (the prefix-cache A/B bench
+        # needs prefills that measurably take chunk-count time, same
+        # idea as tick_sleep_s for windows).
+        def chunk_fn(ids, pools, position, bt):
+            out = chunk_jit(ids, pools, position, bt)
+            time.sleep(chunk_sleep_s)
+            return out
+    else:
+        chunk_fn = chunk_jit
 
     return PagedBatchEngine(
         init_pool=lambda n: {"null": jnp.zeros((1,), jnp.int32)},
@@ -1169,4 +1454,6 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         window=window,
         spec_k=spec_k,
         spec_ngram=spec_ngram,
+        prefix_cache=prefix_cache,
+        prefix_cache_pages=prefix_cache_pages,
     )

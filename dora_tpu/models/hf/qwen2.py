@@ -347,7 +347,9 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
                       num_pages: int | None = None,
                       window: int | None = None,
                       spec_k: int | None = None,
-                      spec_ngram: int | None = None):
+                      spec_ngram: int | None = None,
+                      prefix_cache: bool | None = None,
+                      prefix_cache_pages: int | None = None):
     """Paged-KV continuous-batching engine (requires the quantized fused
     layout, like :func:`make_batch_engine`). Defaults size the pool to
     EXACTLY the dense engine's 4-slot HBM footprint (4 * max_seq KV
@@ -390,6 +392,16 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         spec_k = int(os.environ.get("DORA_SPEC_K", "0"))
     if spec_ngram is None:
         spec_ngram = int(os.environ.get("DORA_SPEC_NGRAM", "2"))
+    # Shared-prefix radix cache (models/prefix_cache.py). Raw-engine
+    # default is OFF (tests/benches get the exact pre-cache program);
+    # the serving front door (nodehub/llm_server.make_engine) defaults
+    # it ON — DORA_PREFIX_CACHE=0 disables it everywhere.
+    if prefix_cache is None:
+        prefix_cache = os.environ.get("DORA_PREFIX_CACHE", "0") != "0"
+    if prefix_cache_pages is None:
+        prefix_cache_pages = int(
+            os.environ.get("DORA_PREFIX_CACHE_PAGES", "0")
+        )
     def window_factory(k, sk):
         # (k, spec) -> jitted window program; PagedBatchEngine caches
         # built programs so the autotuner's ladder compiles each rung
@@ -439,6 +451,8 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         eos=eos,
         spec_k=spec_k,
         spec_ngram=spec_ngram,
+        prefix_cache=prefix_cache,
+        prefix_cache_pages=prefix_cache_pages,
     )
 
 

@@ -1,0 +1,249 @@
+"""Radix prefix cache over the paged KV pool (RadixAttention-style).
+
+Millions of requests open with the same system prompt / few-shot
+template; every admission used to re-prefill and re-store those rows.
+This cache turns cross-request prefix reuse into an admission-time
+lookup: a radix tree keyed on PAGE-GRANULARITY token-id chunks, where
+each node owns one physical KV page whose ``page_size`` rows hold
+exactly the KV of that chunk, computed once by whichever stream got
+there first.
+
+Custody is refcounts, not copies (models/batch_engine.PageAllocator):
+
+* ``insert`` adopts a completed prompt's fully-populated pages — the
+  cache takes ONE allocator reference per new node, so the pages
+  outlive the stream that computed them.
+* ``lookup`` walks the longest cached page-aligned prefix of a new
+  prompt; the engine refs those pages into the new stream's block
+  table and starts prefill at the divergence point. Shared pages are
+  immutable: chunk prefill and decode only ever write rows past the
+  shared prefix, which land in the stream's own fresh pages (the
+  copy-on-write boundary page is re-materialized by the divergence
+  chunk, never written in place — no kernel changes).
+* ``evict`` drops unpinned, unshared pages LRU-leaf-first when the
+  pool is under admission pressure. Eviction yields to admission —
+  cached pages are a bonus, never a reason to shed — and a page still
+  shared with a live stream (refcount > 1) is in active use, so it is
+  never evicted out from under the stream; dropping the cache's
+  reference to it would not free a page anyway.
+* ``pin``/``unpin`` protect a preempted victim's prefix path from
+  eviction while it waits to resume (refcount custody, not slot
+  custody): resume re-prefills only the unshared tail.
+
+Token ids are exact-match keys (no hashing, no collisions): two
+prompts share a node only when their page-size chunk of token ids is
+identical, which is the greedy-exactness contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+
+class _Node:
+    __slots__ = ("key", "page", "children", "parent", "last_used", "pins")
+
+    def __init__(self, key: tuple, page: int | None, parent: "_Node | None"):
+        self.key = key          # edge label: page_size token ids
+        self.page = page        # physical page id (None only at root)
+        self.children: dict[tuple, _Node] = {}
+        self.parent = parent
+        self.last_used = 0
+        self.pins = 0
+
+
+class PrefixCache:
+    """See module docstring. One instance per PagedBatchEngine; all
+    methods run on the scheduler thread (no locking)."""
+
+    def __init__(self, allocator, page_size: int, *, max_pages: int = 0):
+        self.allocator = allocator
+        self.page_size = page_size
+        #: optional hard cap on cached pages (0 = bounded only by pool
+        #: pressure); insert evicts LRU past it
+        self.max_pages = max_pages
+        self._root = _Node((), None, None)
+        self._clock = itertools.count(1)
+        #: pages (== nodes) currently held by the cache
+        self.size = 0
+        # -- accounting (cumulative; surfaced via ServingMetrics) --
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+        #: boundary pages re-materialized privately because the
+        #: divergence point fell inside a cached page (mid-page
+        #: divergence, or a fully-cached prompt re-running its final
+        #: page to produce the first token)
+        self.cow_copies = 0
+
+    def _chunks(self, ids) -> list[tuple]:
+        ps = self.page_size
+        return [
+            tuple(ids[i : i + ps])
+            for i in range(0, (len(ids) // ps) * ps, ps)
+        ]
+
+    # -- lookup / insert -----------------------------------------------------
+
+    def lookup(self, ids) -> tuple[int, list[int], bool]:
+        """Longest cached page-aligned prefix of ``ids``.
+
+        Returns ``(matched_tokens, pages, mid_page)``: the matched
+        length (a multiple of ``page_size``), the cached page ids in
+        prefix order, and whether the divergence falls INSIDE the next
+        cached page (some cached edge shares a proper prefix with the
+        next chunk — the copy-on-write boundary case). Touches the
+        matched path's LRU stamps; hit/miss accounting is the
+        engine's, made against the prefix length it actually maps."""
+        now = next(self._clock)
+        node = self._root
+        pages: list[int] = []
+        for key in self._chunks(ids):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = now
+            pages.append(child.page)
+            node = child
+        matched = len(pages) * self.page_size
+        tail = tuple(ids[matched : matched + self.page_size])
+        mid_page = bool(tail) and any(
+            k[0] == tail[0] for k in node.children
+        )
+        return matched, pages, mid_page
+
+    def insert(self, ids, pages: list[int]) -> int:
+        """Adopt a completed prompt's fully-populated pages: one node
+        per page-size chunk of ``ids``, each new node taking one
+        allocator reference on its page. Existing nodes keep their
+        page (first writer wins — the duplicate page stays private to
+        its stream and frees with it). Returns pages adopted."""
+        now = next(self._clock)
+        node = self._root
+        new = 0
+        for key, page in zip(self._chunks(ids), pages):
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, page, node)
+                node.children[key] = child
+                self.allocator.ref([page])
+                self.size += 1
+                new += 1
+            child.last_used = now
+            node = child
+        self.inserted_pages += new
+        if self.max_pages and self.size > self.max_pages:
+            self.evict(self.size - self.max_pages)
+        return new
+
+    # -- pin / unpin (preempted victims) -------------------------------------
+
+    def pin(self, ids) -> int:
+        """Pin the cached path matching ``ids`` against eviction (one
+        pin per node; nestable). Returns the pinned token length."""
+        node = self._root
+        n = 0
+        for key in self._chunks(ids):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.pins += 1
+            n += self.page_size
+            node = child
+        return n
+
+    def unpin(self, ids) -> None:
+        """Release one pin along the matching path (tolerates a path
+        shorter than at pin time — impossible while pinned, but unpin
+        must never raise on teardown)."""
+        node = self._root
+        for key in self._chunks(ids):
+            child = node.children.get(key)
+            if child is None:
+                break
+            if child.pins > 0:
+                child.pins -= 1
+            node = child
+
+    # -- eviction (pool pressure) --------------------------------------------
+
+    def evictable_pages(self) -> int:
+        """Pages eviction could return to the free list RIGHT NOW:
+        nodes that are unpinned, unshared (refcount 1 — only the cache
+        holds them), and whose whole subtree is likewise evictable (a
+        pinned or in-use descendant keeps its ancestors reachable).
+        Admission counts these as free-in-waiting."""
+
+        def walk(n: _Node) -> tuple[bool, int]:
+            total = 0
+            ok_all = True
+            for c in n.children.values():
+                ok, cnt = walk(c)
+                total += cnt
+                ok_all = ok_all and ok
+            if n is self._root:
+                return True, total
+            ok = (
+                ok_all
+                and n.pins == 0
+                and self.allocator.refcount(n.page) == 1
+            )
+            return ok, total + (1 if ok else 0)
+
+        return walk(self._root)[1]
+
+    def evict(self, need: int) -> int:
+        """Free up to ``need`` pages, least-recently-used leaves first
+        (a parent becomes a leaf once its children are gone, so cold
+        branches unwind bottom-up). Skips pinned nodes and pages still
+        shared with live streams. Returns pages actually freed."""
+        freed = 0
+        while freed < need:
+            best: _Node | None = None
+            stack = list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n.children or n.pins:
+                    continue
+                if self.allocator.refcount(n.page) != 1:
+                    continue
+                if best is None or n.last_used < best.last_used:
+                    best = n
+            if best is None:
+                break
+            del best.parent.children[best.key]
+            self.allocator.unref([best.page])
+            self.size -= 1
+            freed += 1
+        self.evicted_pages += freed
+        return freed
+
+    def flush(self) -> int:
+        """Evict everything evictable (tests / shutdown)."""
+        return self.evict(self.size)
+
+    # -- introspection -------------------------------------------------------
+
+    def pages(self):
+        """Iterate every cached page id (invariant checks)."""
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n.page
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else None,
+            "hit_tokens": self.hit_tokens,
+            "cached_pages": self.size,
+            "inserted_pages": self.inserted_pages,
+            "evicted_pages": self.evicted_pages,
+            "cow_copies": self.cow_copies,
+        }

@@ -109,9 +109,14 @@ def make_engine(params, cfg, eos=None):
     chunk_env = os.environ.get("DORA_PREFILL_CHUNK")
     chunk = int(chunk_env) if chunk_env else None
     window = int(os.environ.get("DORA_MULTISTEP_K", "8"))
+    # Shared-prefix radix cache: default ON at the serving front door
+    # (DORA_PREFIX_CACHE=0 restores the exact pre-cache program).
+    prefix_on = os.environ.get("DORA_PREFIX_CACHE", "1") != "0"
+    prefix_pages = int(os.environ.get("DORA_PREFIX_CACHE_PAGES", "0"))
     return qwen2.make_paged_engine(
         params, cfg, max_slots=slots, eos=eos, page_size=page_size,
-        chunk=chunk, window=window,
+        chunk=chunk, window=window, prefix_cache=prefix_on,
+        prefix_cache_pages=prefix_pages,
     )
 
 
@@ -476,6 +481,11 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     admit_seq: dict[str, int] = {}
     admit_counter = [0]
     preempted_keys: set[str] = set()
+    #: engine key -> tokens whose cached-prefix path is PINNED while
+    #: the preempted victim waits to resume (refcount custody, not slot
+    #: custody: the pages stay in the prefix cache, immune to pool-
+    #: pressure eviction, so resume re-prefills only the unshared tail)
+    pinned_prefix: dict[str, list[int]] = {}
     #: engine key -> wire request_id. The ENGINE key is always unique
     #: (req-N): two in-flight requests carrying the same wire
     #: ``request_id`` must not share a slot key, or their token streams
@@ -502,6 +512,11 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         req_emitted.pop(key, None)
         admit_seq.pop(key, None)
         preempted_keys.discard(key)
+        pinned = pinned_prefix.pop(key, None)
+        if pinned is not None and hasattr(engine, "prefix_unpin"):
+            # A parked victim that never resumed (shed, error, drain)
+            # must release its eviction pin.
+            engine.prefix_unpin(pinned)
 
     def emit_text(
         key: str, text: str, done: bool, finish: str | None = None,
@@ -570,6 +585,12 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             metrics.resumed += 1
             tracer.span("s_resume", key, f"recompute={len(ids)}")
         res = engine.submit(key, ids, max_new)
+        pinned = pinned_prefix.pop(key, None)
+        if pinned is not None:
+            # Unpin AFTER submit: the resume lookup refs the shared
+            # pages into the new grant first, so dropping the eviction
+            # pin can no longer lose them.
+            engine.prefix_unpin(pinned)
         if res is not None:  # dense engine: first token is synchronous
             emit(key, *res)
         # paged engine: submit queues the prefill; the first token is
@@ -623,12 +644,17 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             emit_text(victim, "", True, finish="length")
             return True
         preempted_keys.add(victim)
-        backlog.requeue(
-            victim,
-            list(req_prompt.get(victim, [])) + list(req_emitted.get(victim, [])),
-            remaining,
-            req_class.get(victim),
+        resume_ids = (
+            list(req_prompt.get(victim, []))
+            + list(req_emitted.get(victim, []))
         )
+        if hasattr(engine, "prefix_pin") and engine.prefix_pin(resume_ids):
+            # The victim's cached prefix pages survive the park on
+            # refcount custody: resume re-prefills only the unshared
+            # tail instead of re-paying the whole prefill.
+            pinned_prefix[victim] = resume_ids
+        backlog.requeue(victim, resume_ids, remaining,
+                       req_class.get(victim))
         return True
 
     #: requests that arrived while the engine couldn't admit them
@@ -899,6 +925,15 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 metrics.largest_contig_free = (
                     alloc.largest_contiguous_free()
                 )
+            pc = getattr(engine, "prefix_cache", None)
+            if pc is not None:
+                metrics.prefix_hits = pc.hits
+                metrics.prefix_misses = pc.misses
+                metrics.prefix_hit_tokens = pc.hit_tokens
+                metrics.prefix_cached_pages = pc.size
+                metrics.prefix_shared_pages = engine.shared_pages
+                metrics.prefix_cow_copies = pc.cow_copies
+                metrics.prefix_evictions = pc.evicted_pages
         metrics.qos_depth = backlog.depths()
         metrics.autotune_k = getattr(engine, "window", 0)
         check_slo(now)
@@ -1252,6 +1287,10 @@ def _stub_main() -> None:
         spec_k=int(os.environ.get("DORA_SPEC_K", "0") or 0),
         spec_ngram=int(os.environ.get("DORA_SPEC_NGRAM", "2") or 2),
         cycle=int(cycle_env) if cycle_env else None,
+        prefix_cache=os.environ.get("DORA_PREFIX_CACHE", "1") != "0",
+        prefix_cache_pages=int(
+            os.environ.get("DORA_PREFIX_CACHE_PAGES", "0") or 0
+        ),
     )
     delay = float(os.environ.get("DORA_STEP_DELAY_S", "0") or 0)
     if delay > 0:

@@ -59,6 +59,13 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_serving_retunes_total": ("counter", "Fused-window K retunes applied by the SLO autotuner"),
     "dora_serving_qos_depth": ("gauge", "Admission-backlog depth per QoS class"),
     "dora_serving_autotune_k": ("gauge", "Live fused-window K (decode ticks per dispatch)"),
+    "dora_serving_prefix_hits_total": ("counter", "Admissions that mapped cached prefix pages"),
+    "dora_serving_prefix_misses_total": ("counter", "Admissions with no usable cached prefix"),
+    "dora_serving_prefix_hit_tokens_total": ("counter", "Prompt tokens served from the prefix cache"),
+    "dora_serving_prefix_cow_copies_total": ("counter", "Copy-on-write boundary pages re-materialized"),
+    "dora_serving_prefix_evictions_total": ("counter", "Cached prefix pages evicted under pool pressure"),
+    "dora_serving_prefix_cached_pages": ("gauge", "KV pages held by the radix prefix cache"),
+    "dora_serving_prefix_shared_pages": ("gauge", "Cached pages currently mapped shared into live streams"),
 }
 
 #: (snapshot serving key, metric family) pairs for the per-node scalars
@@ -73,6 +80,11 @@ _SERVING_COUNTERS = (
     ("preempted", "dora_serving_preempted_total"),
     ("resumed", "dora_serving_resumed_total"),
     ("retunes", "dora_serving_retunes_total"),
+    ("prefix_hits", "dora_serving_prefix_hits_total"),
+    ("prefix_misses", "dora_serving_prefix_misses_total"),
+    ("prefix_hit_tokens", "dora_serving_prefix_hit_tokens_total"),
+    ("prefix_cow_copies", "dora_serving_prefix_cow_copies_total"),
+    ("prefix_evictions", "dora_serving_prefix_evictions_total"),
 )
 _SERVING_GAUGES = (
     ("slots_active", "dora_serving_slots_active"),
@@ -82,6 +94,8 @@ _SERVING_GAUGES = (
     ("total_pages", "dora_serving_total_pages"),
     ("backlog_depth", "dora_serving_backlog_depth"),
     ("autotune_k", "dora_serving_autotune_k"),
+    ("prefix_cached_pages", "dora_serving_prefix_cached_pages"),
+    ("prefix_shared_pages", "dora_serving_prefix_shared_pages"),
 )
 
 
@@ -319,6 +333,13 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "total_pages": 64,
                     "backlog_depth": 1,
                     "autotune_k": 8,
+                    "prefix_hits": 30,
+                    "prefix_misses": 12,
+                    "prefix_hit_tokens": 960,
+                    "prefix_cow_copies": 4,
+                    "prefix_evictions": 6,
+                    "prefix_cached_pages": 20,
+                    "prefix_shared_pages": 9,
                     "qos_depth": {"interactive": 0, "standard": 1, "batch": 3},
                     "ttft_us": hist.snapshot(),
                 }

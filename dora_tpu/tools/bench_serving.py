@@ -43,10 +43,20 @@ acceptance headline is ``interactive_p99_on_vs_off`` < 1.0: shaping
 must buy the interactive class latency under overload, paid for by the
 batch class, never by silent loss (completion accounting rides along).
 
+A seventh axis behind ``--prefix-ab``: the shared-prefix KV cache at
+admission (DORA_PREFIX_CACHE), a Zipf-popular template workload (hot
+system prompts, unique tails) replayed open-loop with the cache on vs
+off over the identical arrival trace — hit rate, TTFT p50/p99 for hit
+requests vs the same requests uncached, prefill-chunk deltas, pool
+occupancy. The acceptance headline is ``hit_p50_on_vs_off`` <= 0.5: a
+cache hit must at least halve first-token latency to justify the
+serving default-on.
+
 Usage::
 
     python -m dora_tpu.tools.bench_serving [--multistep | --trace-ab |
-                                            --spec-ab | --qos-soak]
+                                            --spec-ab | --qos-soak |
+                                            --prefix-ab]
 """
 
 from __future__ import annotations
@@ -503,11 +513,166 @@ def _qos_soak() -> dict:
     }
 
 
+def _prefix_ab() -> dict:
+    """Shared-prefix cache A/B behind ``--prefix-ab``: a Zipf-popular
+    template workload (few hot system prompts, many unique tails — the
+    multi-tenant serving shape the radix cache targets) replayed
+    open-loop against the stub paged engine twice, cache on vs off,
+    same seeded arrival trace.
+
+    ``chunk_sleep_s`` gives each prefill chunk a measurable device
+    cost, so TTFT is proportional to chunks actually run — a cache hit
+    skips the shared-prefix chunks and the A/B shows up in first-token
+    latency, not just counters. The headline gate compares TTFT p50 of
+    the on-leg's HIT requests against the SAME request ids in the off
+    leg (>= 2x reduction justifies default-on); hit rate, prefill-chunk
+    deltas, pool occupancy, and eviction counts ride along."""
+    import numpy as np
+
+    from dora_tpu.metrics import ServingMetrics
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+    from dora_tpu.nodehub.llm_server import serve
+
+    streams = int(os.environ.get("DORA_BENCH_PREFIX_STREAMS", "120"))
+    templates, prefix_len, tail_len = 8, 64, 8
+    max_new, chunk_sleep = 8, 0.002
+    rng = np.random.default_rng(11)
+    # Zipf(1.2) popularity over the template set: template 0 dominates,
+    # the tail templates are cold — hits concentrate where reuse does.
+    weights = 1.0 / np.arange(1, templates + 1) ** 1.2
+    weights /= weights.sum()
+    picks = rng.choice(templates, size=streams, p=weights)
+    # Light open-loop load: TTFT is dominated by the prefill the
+    # request actually runs, not by backlog wait, so the A/B reads as
+    # chunks-skipped, not queueing theory.
+    gaps = rng.exponential(0.015, size=streams)
+    tmpl_ids = [
+        [int(t) for t in rng.integers(1, 90, size=prefix_len)]
+        for _ in range(templates)
+    ]
+    arrivals = []
+    t = 0.0
+    for n in range(streams):
+        t += float(gaps[n])
+        tail = [int(x) for x in rng.integers(1, 90, size=tail_len)]
+        arrivals.append((t, f"p{n}", tmpl_ids[picks[n]] + tail))
+
+    def leg(cache: bool) -> dict:
+        engine = make_stub_paged_engine(
+            max_slots=8, max_seq=128, page_size=8, chunk=16,
+            window=4, chunk_sleep_s=chunk_sleep,
+            prefix_cache=cache,
+        )
+        hit_rids: set[str] = set()
+        pc = engine.prefix_cache
+        if pc is not None:
+            # serve() renames streams req-N; recover the trace's rid by
+            # prompt identity (tails are unique by construction).
+            rid_by_prompt = {tuple(ids): rid for _at, rid, ids in arrivals}
+            orig_submit = engine.submit
+
+            def submit(key, ids, max_new):
+                h0 = pc.hits
+                res = orig_submit(key, ids, max_new)
+                if pc.hits > h0:
+                    hit_rids.add(rid_by_prompt[tuple(ids)])
+                return res
+
+            engine.submit = submit
+        schedule = [
+            (at, {
+                "type": "INPUT",
+                "metadata": {
+                    "request_id": rid,
+                    "max_new_tokens": max_new,
+                },
+                "value": " ".join(str(t) for t in ids).encode(),
+            })
+            for at, rid, ids in arrivals
+        ]
+        node = _OpenLoopNode(schedule)
+        metrics = ServingMetrics(engine="paged")
+        c0 = engine.chunks_run
+        t0 = time.perf_counter()
+        serve(
+            node, engine, metrics,
+            encode=lambda text: [int(t) for t in text.split()],
+            decode_one=lambda tok: f" t{tok}",
+            max_new_cap=max_new,
+        )
+        wall = time.perf_counter() - t0
+        ttft_by_rid: dict[str, float] = {}
+        for ts, meta in node.sent:
+            rid = meta.get("request_id")
+            if rid is not None and rid not in ttft_by_rid:
+                ttft_by_rid[rid] = ts
+        ttfts = {}
+        for at, rid, _ids in arrivals:
+            assert rid in ttft_by_rid, f"stream {rid} silently lost"
+            ttfts[rid] = ttft_by_rid[rid] - at
+        out = {
+            "wall_s": round(wall, 2),
+            "prefill_chunks": engine.chunks_run - c0,
+            "peak_used_pages": engine.allocator.peak_in_use,
+            "total_pages": engine.allocator.num_pages,
+            "ttfts": ttfts,
+            "hit_rids": sorted(hit_rids),
+        }
+        if pc is not None:
+            out["cache"] = pc.stats()
+        return out
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        o = sorted(vals)
+        return round(o[min(len(o) - 1, int(len(o) * q))] * 1e3, 2)
+
+    on, off = leg(cache=True), leg(cache=False)
+    hit_rids = set(on["hit_rids"])
+    hit_on = [v for r, v in on["ttfts"].items() if r in hit_rids]
+    hit_off = [v for r, v in off["ttfts"].items() if r in hit_rids]
+    all_on = list(on["ttfts"].values())
+    all_off = list(off["ttfts"].values())
+    for legd in (on, off):  # raw per-rid map served its purpose
+        del legd["ttfts"], legd["hit_rids"]
+    cache = on.get("cache", {})
+    lookups = cache.get("hits", 0) + cache.get("misses", 0)
+    p50_on, p50_off = pct(hit_on, 0.50), pct(hit_off, 0.50)
+    return {
+        "streams": streams,
+        "templates": templates,
+        "prefix_len": prefix_len,
+        "tail_len": tail_len,
+        "hit_rate": round(cache.get("hits", 0) / lookups, 3) if lookups else None,
+        "hit_requests": len(hit_rids),
+        "cache_on": on,
+        "cache_off": off,
+        "ttft_ms": {
+            "hit_on": {"p50": p50_on, "p99": pct(hit_on, 0.99)},
+            "hit_rids_off": {"p50": p50_off, "p99": pct(hit_off, 0.99)},
+            "all_on": {"p50": pct(all_on, 0.50), "p99": pct(all_on, 0.99)},
+            "all_off": {"p50": pct(all_off, 0.50), "p99": pct(all_off, 0.99)},
+        },
+        # The default-on gate: hit-request TTFT p50, cache on vs the
+        # same requests cache off. <= 0.5 means >= 2x faster.
+        "hit_p50_on_vs_off": (
+            round(p50_on / p50_off, 3) if p50_on is not None and p50_off
+            else None
+        ),
+    }
+
+
 def main() -> int:
     import numpy as np
 
     from dora_tpu.models.hf import qwen2
 
+    if "--prefix-ab" in sys.argv[1:]:
+        # Stub-engine leg: the cache lives in the admission plane; the
+        # A/B measures chunks skipped, not model quality.
+        print(json.dumps({"prefix_ab": _prefix_ab()}))
+        return 0
     if "--qos-soak" in sys.argv[1:]:
         # Stub-engine leg: the QoS machinery is engine-agnostic, the
         # soak measures the ADMISSION plane, not the model.

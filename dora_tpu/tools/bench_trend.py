@@ -43,6 +43,10 @@ WATCHED: dict[str, str] = {
     # Traffic-shaping soak: the on/off interactive TTFT p99 ratio —
     # a drift toward 1.0 means shaping stopped buying latency.
     "serving_qos_soak.interactive_p99_on_vs_off": "lower",
+    # Shared-prefix cache A/B: hit-request TTFT p50 ratio on/off — a
+    # drift toward 1.0 means cache hits stopped buying first-token
+    # latency (the default-on gate is <= 0.5).
+    "serving_prefix_ab.hit_p50_on_vs_off": "lower",
 }
 
 #: flag when a watched metric is worse than the previous run by more

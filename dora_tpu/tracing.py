@@ -71,6 +71,10 @@ SERVING_SPAN_KINDS = {
     # … decode_window → preempt → queued → resume → prefill_chunk …
     "s_preempt": "preempt",
     "s_resume": "resume",
+    # Shared-prefix cache: admission mapped cached KV pages into the new
+    # stream's block table (prefill starts at the divergence point).
+    # Emitted just before s_admitted, with the same trace context.
+    "s_prefix_hit": "prefix_hit",
 }
 
 #: Hot-path flight events surfaced as instants (everything else recorded
@@ -384,7 +388,8 @@ def _sample_snapshots() -> list[dict]:
             "llm": [
                 [40, base + 8_700_000, "trace_truncated", 17, None, None],
                 [41, base + 8_900_000, "s_queued", "req-1", rctx, 100_000],
-                [42, base + 9_000_000, "s_admitted", "req-1 pages=2", rctx, 20_000],
+                [52, base + 8_990_000, "s_prefix_hit", "req-1 tokens=16/24 pages=2", rctx, 0],
+                [42, base + 9_000_000, "s_admitted", "req-1 pages=2 shared=2", rctx, 20_000],
                 [43, base + 9_300_000, "s_prefill_chunk", "req-1 base=0", rctx, 200_000],
                 [44, base + 9_800_000, "s_decode_window", "req-1 k=8 n=5", rctx, 400_000],
                 [45, base + 9_850_000, "xla_compile", "window", None, 3_000_000],
@@ -438,8 +443,8 @@ def self_check() -> list[str]:
         if ev["ph"] == "X" and ev.get("cat") == "serving"
     ]
     chain = [ev["name"].split(" ", 1)[0] for ev in engine_spans]
-    want = ["queued", "admitted", "prefill_chunk", "decode_window",
-            "preempt", "resume", "finish"]
+    want = ["queued", "prefix_hit", "admitted", "prefill_chunk",
+            "decode_window", "preempt", "resume", "finish"]
     if chain != want:
         errors.append(f"lifecycle chain broken: {chain}")
     if any(ev.get("args", {}).get("trace_id") not in ids for ev in engine_spans):

@@ -73,6 +73,7 @@ def test_checkpoint_restore_token_identical():
     _run_to_done(b, post)
     for rid in ("r0", "r1"):
         assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+    b.check_invariants()
 
 
 def test_drain_admit_streams_token_identical():
@@ -97,6 +98,68 @@ def test_drain_admit_streams_token_identical():
     assert set(admitted) == {"r0", "r1"}
     post: dict[str, list[int]] = {}
     _run_to_done(b, post)
+    for rid in ("r0", "r1"):
+        assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
+    a.check_invariants()
+    b.check_invariants()
+
+
+def test_checkpoint_restore_rebuilds_shared_page_custody():
+    """Prefix-shared pages appear in SEVERAL slots' grants (and in the
+    cache's radix tree): restore with pin_slots must rebuild the exact
+    refcounts — first holder takes each physical page, later holders
+    ref-share it — or a restored engine would double-take or leak on
+    the next preemption."""
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    def build():
+        return make_stub_paged_engine(
+            max_slots=3, max_seq=64, page_size=8, chunk=16,
+            prefix_cache=True,
+        )
+
+    tmpl = list(range(1, 33))  # 4 shared pages once cached
+    a = build()
+    a.submit("warm", tmpl + [50, 51], 4)
+    tokens: dict[str, list[int]] = {}
+    _run_to_done(a, tokens)  # template now cached
+    a.submit("r0", tmpl + [60, 61], 8)
+    a.submit("r1", tmpl + [70, 71, 72], 8)
+    pre: dict[str, list[int]] = {}
+    while a.prefilling:  # snapshot at a decode boundary: slots pinned
+        for key, token, done in a.step():
+            pre.setdefault(key, []).append(int(token))
+    assert a.shared_pages >= 8  # both streams map the cached prefix
+    a.check_invariants()
+    snap = json.loads(json.dumps(a.checkpoint_state()))
+    shared_counts = [m["shared"] for m in snap["slots"]]
+    assert all(n >= 4 for n in shared_counts), shared_counts
+    # the SAME physical pages appear in both slots' grants
+    grants = [m["pages"] for m in snap["slots"]]
+    overlap = set(grants[0]) & set(grants[1])
+    assert len(overlap) >= 4, grants
+
+    b = build()
+    restored = b.restore_state(snap, pin_slots=True)
+    assert set(restored) == {"r0", "r1"}
+    # claimed-set custody: each shared page was taken once and
+    # ref-shared by the second slot — refcount equals its holders
+    for p in overlap:
+        assert b.allocator.refcount(p) == 2, p
+    b.check_invariants()
+    post: dict[str, list[int]] = {}
+    _run_to_done(b, post)
+    b.check_invariants()
+    assert b.free_pages == b.allocator.num_pages - 1  # every page home
+
+    # The uninterrupted reference: same prompts, cold engine.
+    ref_engine = build()
+    ref_engine.submit("warm", tmpl + [50, 51], 4)
+    _run_to_done(ref_engine, {})
+    ref_engine.submit("r0", tmpl + [60, 61], 8)
+    ref_engine.submit("r1", tmpl + [70, 71, 72], 8)
+    ref: dict[str, list[int]] = {}
+    _run_to_done(ref_engine, ref)
     for rid in ("r0", "r1"):
         assert pre.get(rid, []) + post.get(rid, []) == ref[rid], rid
 

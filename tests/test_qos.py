@@ -480,3 +480,68 @@ def test_burn_window_complete_gating():
     assert not burn_window_complete(11, 60.0, 5.0)
     assert burn_window_complete(1, 3.0, 5.0)  # window shorter than tick
     assert not burn_window_complete(100, 60.0, 0.0)  # degenerate interval
+
+
+def test_preempt_resume_repays_only_unshared_prefill_on_cache_hit(
+    monkeypatch,
+):
+    """KNOWN_ISSUES round 14 retired for cache hits: a preempted
+    stream's resume used to re-pay its WHOLE prefill. With the prefix
+    cache on, preemption pins the victim's prompt+emitted path, so the
+    re-submit maps the cached pages and re-prefills only the unshared
+    tail — strictly fewer prefill chunks than the cache-off run, same
+    tokens."""
+    pytest.importorskip("jax")
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    class _GatedNode(_Node):
+        """Holds the interactive request back until the victim emitted
+        its first token — which guarantees the victim's final prefill
+        chunk ran (and, cache-on, its prompt pages were inserted)."""
+
+        def __init__(self, first, gated):
+            super().__init__([first])
+            self._gated = gated
+
+        def recv(self, timeout=None):
+            if self._gated and any(
+                m.get("request_id") == "w-b" and not m.get("done")
+                for _t, _o, m in self.sent
+            ):
+                return self._gated.pop(0)
+            if self._events:
+                return self._events.pop(0)
+            if self._gated:
+                return None  # stream stays open until the gate releases
+            self.stream_ended = True
+            return None
+
+    def leg(cache: bool):
+        engine = make_stub_paged_engine(
+            max_slots=1, window=4, max_seq=128, prefix_cache=cache,
+        )
+        node = _GatedNode(
+            _req("w-b", "0123456789abcdef", 20, "batch"),  # 16 tokens
+            [_req("w-i", "hi", 3, "interactive")],
+        )
+        metrics = ServingMetrics(engine="paged")
+        serve(
+            node, engine, metrics,
+            encode=lambda text: [ord(ch) % 97 + 1 for ch in text] or [1],
+            decode_one=lambda tok: f" t{tok}",
+            max_new_cap=64,
+        )
+        return engine, node, metrics
+
+    monkeypatch.setenv("DORA_QOS_PREEMPT", "1")
+    e_off, n_off, m_off = leg(cache=False)
+    e_on, n_on, m_on = leg(cache=True)
+    for m in (m_off, m_on):
+        assert m.preempted >= 1 and m.resumed >= 1
+    for rid in ("w-b", "w-i"):
+        assert _tokens(n_on, rid) == _tokens(n_off, rid), rid
+    assert e_on.prefix_cache.hits >= 1  # the resume mapped cached pages
+    assert e_on.chunks_run < e_off.chunks_run, (
+        e_on.chunks_run, e_off.chunks_run
+    )
+    e_on.check_invariants()
