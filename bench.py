@@ -582,6 +582,43 @@ def serving_trace_ab() -> dict:
     return data
 
 
+def serving_profiling_ab() -> dict:
+    """Device-monitor A/B (tools/bench_serving --profiling-ab): the
+    round-16 utilization plane (window time attribution via
+    block_until_ready, FLOPs ledger, dev-phase spans) on vs off at 16
+    streams on the stub engine, trials interleaved. Gate: <= 3%
+    wall-clock overhead so the plane can stay default-on. Fresh
+    subprocess for the same accelerator-claim reason as
+    serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--profiling-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "profiling_ab" in row:
+            data = row["profiling_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "monitor_off_wall_s": None,
+            "monitor_on_wall_s": None,
+            "overhead_pct": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_spec_ab() -> dict:
     """Speculative-decoding sweep (tools/bench_serving --spec-ab):
     tokens per dispatch and draft acceptance at spec_k in {0, 2, 4} x
@@ -888,6 +925,16 @@ def main() -> int:
         }
 
     try:
+        profiling_ab = serving_profiling_ab()
+    except Exception as exc:
+        profiling_ab = {
+            "monitor_off_wall_s": None,
+            "monitor_on_wall_s": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         qos_soak = serving_qos_soak()
     except Exception as exc:
         qos_soak = {
@@ -944,6 +991,7 @@ def main() -> int:
         "serving_multistep_ab": multistep_ab,
         "serving_trace_ab": trace_ab,
         "serving_spec_ab": spec_ab,
+        "serving_profiling_ab": profiling_ab,
         "serving_qos_soak": qos_soak,
         "serving_prefix_ab": prefix_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),

@@ -517,6 +517,36 @@ def cmd_migrate(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Run an on-demand deep profile capture (``jax.profiler.trace``)
+    on a serving node and print the artifact path. ``--stop`` ends an
+    in-flight capture early. On backends without a working profiler the
+    artifact is a synthetic JSON marker explaining why."""
+    with _control(args) as c:
+        if args.stop:
+            request = cm.StopProfile(
+                dataflow_uuid=args.uuid, node_id=args.node, name=args.name,
+            )
+        else:
+            request = cm.StartProfile(
+                dataflow_uuid=args.uuid, node_id=args.node,
+                seconds=args.seconds, name=args.name,
+            )
+        reply = c.request(request)
+        if isinstance(reply, cm.Error):
+            print(reply.message, file=sys.stderr)
+            return 1
+        if reply.error:
+            print(
+                f"profile on {reply.node_id} of {reply.uuid} failed: "
+                f"{reply.error}",
+                file=sys.stderr,
+            )
+            return 1
+        print(reply.artifact)
+    return 0
+
+
 def cmd_logs(args) -> int:
     with _control(args) as c:
         reply = c.request(cm.Logs(uuid=args.uuid, name=args.name, node=args.node))
@@ -709,6 +739,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--name", default=None)
     coordinator_addr(p)
     p.set_defaults(fn=cmd_migrate)
+
+    p = sub.add_parser(
+        "profile",
+        help="capture a deep device profile on a serving node",
+    )
+    p.add_argument("node", help="node id of the serving engine to profile")
+    p.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="capture duration before the node stops and reports (default 5)",
+    )
+    p.add_argument(
+        "--stop", action="store_true",
+        help="stop an in-flight capture early and fetch its artifact",
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("logs", help="print a node's logs")
     p.add_argument("node")

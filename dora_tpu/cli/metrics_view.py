@@ -28,6 +28,14 @@ def _fmt_us(us: float | None) -> str:
 
 _SPARK = " ▁▂▃▄▅▆▇█"
 
+#: serving-snapshot keys that mark a node as carrying the round-16
+#: device utilization plane (any present -> UTIL table renders)
+_UTIL_KEYS = (
+    "mfu", "device_busy_fraction", "hbm_used_bytes", "hbm_limit_bytes",
+    "hbm_peak_bytes", "device_compute_ns", "host_dispatch_ns",
+    "device_fetch_ns",
+)
+
 
 def _sparkline(fracs: list[float]) -> str:
     """0..1 fractions as block characters (page-occupancy history)."""
@@ -39,11 +47,14 @@ def _sparkline(fracs: list[float]) -> str:
 
 def _rate(cur: int, before: int, dt: float) -> str:
     """Counter delta over ``dt`` seconds. A negative delta means the
-    counter reset (node restart) — render ``-`` instead of a fabricated
-    negative rate."""
+    counter reset to zero (node restart / engine restore re-reporting
+    from scratch) — the current value IS the progress since the reset,
+    so rate that instead (mirrors the history ring's delta decoder;
+    the old ``-`` rendering blanked every rate for a full watch tick
+    after a respawn)."""
     delta = cur - before
     if delta < 0:
-        return "-"
+        delta = cur
     return f"{delta / dt:.1f}"
 
 
@@ -109,9 +120,9 @@ def render_metrics(
             before = prev_links.get(key, {})
             row.append(_rate(v.get("msgs", 0), before.get("msgs", 0), dt))
             bdelta = v.get("bytes", 0) - before.get("bytes", 0)
-            row.append(
-                "-" if bdelta < 0 else f"{_fmt_bytes(bdelta / dt)}/s"
-            )
+            if bdelta < 0:  # counter reset: rate the fresh value
+                bdelta = v.get("bytes", 0)
+            row.append(f"{_fmt_bytes(bdelta / dt)}/s")
         link_rows.append(row)
     headers = ["LINK", "MSGS", "BYTES"]
     if rates is not None or dt:
@@ -278,6 +289,61 @@ def render_metrics(
                  "SHARED", "COW", "EVICT"],
                 prefix_rows,
             )
+
+    # Device utilization plane (round 16): MFU / busy fraction / HBM
+    # gauges plus the cumulative window-time attribution. The table
+    # appears once any node ships device keys; individual unknown
+    # gauges (CPU backend exposes no allocator stats, peak FLOPs
+    # undetected) and whole pre-round-16 snapshots render dashes — the
+    # PR-5 backward-compat contract.
+    if serving:
+        util_rows = []
+        for nid in sorted(serving):
+            s = serving[nid]
+            if not any(k in s for k in _UTIL_KEYS):
+                continue
+            mfu = s.get("mfu")
+            busy = s.get("device_busy_fraction")
+            used, limit = s.get("hbm_used_bytes"), s.get("hbm_limit_bytes")
+            peak = s.get("hbm_peak_bytes")
+            hbm = (
+                f"{_fmt_bytes(used)}/{_fmt_bytes(limit)}"
+                if used is not None and limit is not None
+                else "-"
+            )
+            util_rows.append([
+                nid,
+                f"{mfu * 100:.1f}%" if mfu is not None else "-",
+                f"{busy * 100:.0f}%" if busy is not None else "-",
+                hbm,
+                _fmt_bytes(peak) if peak is not None else "-",
+                f"{s.get('device_compute_ns', 0) / 1e6:.0f}ms",
+                f"{s.get('host_dispatch_ns', 0) / 1e6:.0f}ms",
+                f"{s.get('device_fetch_ns', 0) / 1e6:.0f}ms",
+            ])
+        if util_rows:
+            lines += [""] + _table(
+                ["UTIL", "MFU", "BUSY", "HBM", "HBM PEAK", "DEV",
+                 "DISP", "FETCH"],
+                util_rows,
+            )
+            # MFU sparkline over the watch history (one cell per
+            # refresh, newest right) — the at-a-glance "is the device
+            # actually busy".
+            for nid in sorted(serving):
+                s = serving[nid]
+                if s.get("mfu") is None:
+                    continue
+                fracs = []
+                for old in (history or []):
+                    o = (old.get("serving") or {}).get(nid)
+                    if o and o.get("mfu") is not None:
+                        fracs.append(o["mfu"])
+                fracs.append(s["mfu"])
+                lines += [
+                    f"  mfu {nid} [{_sparkline(fracs[-48:])}] "
+                    f"{s['mfu'] * 100:.1f}%"
+                ]
 
     # Elastic-recovery plane: daemon-side respawn/replay counters merge
     # with serving-side checkpoint/migration counters by node id. The

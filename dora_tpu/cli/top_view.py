@@ -25,6 +25,10 @@ from dora_tpu.metrics_history import counter_series, gauge_series
 #: sparkline cells (ring samples) shown per series
 SPARK_POINTS = 40
 
+#: serving-snapshot gauges of the device utilization plane (round 16)
+_UTIL_KEYS = ("mfu", "device_busy_fraction", "hbm_used_bytes",
+              "hbm_limit_bytes", "hbm_peak_bytes")
+
 
 def _spark_of(values: list[float], peak: float | None = None) -> str:
     """Values -> sparkline normalized to their own peak (or ``peak``)."""
@@ -157,6 +161,43 @@ def render_top(uuid: str, snap: dict, history: dict) -> str:
                 f"{s.get('used_pages', 0)}/{total} "
                 f"peak {s.get('peak_used_pages', 0)}"
             ]
+
+    # UTIL: device utilization plane (round 16) — MFU / busy / HBM
+    # gauges from the live snapshot (falling back to the history's
+    # derived util block), MFU sparkline from the ring. Nodes without
+    # device gauges (pre-round-16 snapshots, monitor off) render
+    # dashes or drop out entirely.
+    if serving:
+        hist_util = history.get("util") or {}
+        util_rows = []
+        for nid in sorted(serving):
+            s = serving[nid]
+            u = {**hist_util.get(nid, {}), **{
+                k: s[k] for k in _UTIL_KEYS if s.get(k) is not None
+            }}
+            if not u:
+                continue
+            mfu = u.get("mfu")
+            busy = u.get("device_busy_fraction")
+            used, limit = u.get("hbm_used_bytes"), u.get("hbm_limit_bytes")
+            peak = u.get("hbm_peak_bytes")
+            series = gauge_series(history, f"srv:{nid}:mfu", SPARK_POINTS)
+            util_rows.append([
+                nid,
+                f"{mfu * 100:.1f}%" if mfu is not None else "-",
+                f"{busy * 100:.0f}%" if busy is not None else "-",
+                (
+                    f"{_fmt_bytes(used)}/{_fmt_bytes(limit)}"
+                    if used is not None and limit is not None else "-"
+                ),
+                _fmt_bytes(peak) if peak is not None else "-",
+                _spark_of(series, peak=1.0),
+            ])
+        if util_rows:
+            lines += [""] + _table(
+                ["UTIL", "MFU", "BUSY", "HBM", "HBM PEAK", "MFU TREND"],
+                util_rows,
+            )
 
     # RECOVERY: counters + respawn rate from the ring.
     recovery = snap.get("recovery") or {}

@@ -93,6 +93,9 @@ class Coordinator:
         self._metrics_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._trace_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._history_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        #: correlation for deep-capture requests: (dataflow_id, node_id)
+        #: -> future resolved by ProfileReplyFromDaemon
+        self._profile_waiters: dict[tuple[str, str], asyncio.Future] = {}
         #: Prometheus exposition endpoint (DORA_PROM_PORT)
         self._prom_server: asyncio.AbstractServer | None = None
         self.prom_port: int | None = None
@@ -263,6 +266,12 @@ class Coordinator:
             )
             if fut is not None and not fut.done():
                 fut.set_result(event.history)
+        elif isinstance(event, cm.ProfileReplyFromDaemon):
+            fut = self._profile_waiters.get(
+                (event.dataflow_id, event.node_id)
+            )
+            if fut is not None and not fut.done():
+                fut.set_result((event.artifact, event.error))
         else:
             logger.warning("unexpected daemon event %s", type(event).__name__)
 
@@ -746,6 +755,51 @@ class Coordinator:
                 uuid=df.uuid,
                 node_id=request.node_id,
                 handoff_dir=request.handoff_dir,
+            )
+        if isinstance(request, (cm.StartProfile, cm.StopProfile)):
+            target = request.dataflow_uuid or request.name
+            if target is not None:
+                uuid = self.resolve_name(target)
+            else:
+                uuid = self._query_target(None, None)
+                if isinstance(uuid, cm.Error):
+                    return uuid
+            df = self.running.get(uuid)
+            if df is None:
+                return cm.Error(message=f"dataflow {uuid!r} is not running")
+            node = df.descriptor.node(request.node_id)
+            machine = node.deploy.machine or next(iter(df.machines))
+            starting = isinstance(request, cm.StartProfile)
+            seconds = request.seconds if starting else 0.0
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._profile_waiters[(df.uuid, request.node_id)] = fut
+            self._daemon_send(
+                machine,
+                cm.ProfileDataflowNode(
+                    dataflow_id=df.uuid,
+                    node_id=request.node_id,
+                    action="start" if starting else "stop",
+                    seconds=seconds,
+                ),
+            )
+            # The node runs the capture to its deadline before replying:
+            # the start wait covers capture duration + report cadence;
+            # stop only waits for the next report tick.
+            timeout = seconds + 15.0 if starting else 10.0
+            try:
+                artifact, error = await asyncio.wait_for(fut, timeout=timeout)
+            except asyncio.TimeoutError:
+                return cm.Error(
+                    message=f"profile reply from {request.node_id!r} "
+                    f"timed out after {timeout:.0f}s"
+                )
+            finally:
+                self._profile_waiters.pop((df.uuid, request.node_id), None)
+            return cm.ProfileReply(
+                uuid=df.uuid,
+                node_id=request.node_id,
+                artifact=artifact,
+                error=error,
             )
         if isinstance(request, cm.Logs):
             uuid = self.resolve_name(request.uuid or request.name)

@@ -91,6 +91,14 @@ async def run_attached(
 
     daemon.coordinator_notify = notify
     daemon.log_sink = lambda log: outbox.put_nowait(cm.DaemonLog(log=log))
+    daemon.profile_sink = lambda df_id, node_id, artifact, error: (
+        outbox.put_nowait(
+            cm.ProfileReplyFromDaemon(
+                dataflow_id=df_id, node_id=node_id,
+                artifact=artifact, error=error,
+            )
+        )
+    )
 
     def send_inter(df, machine, output_id, metadata, payload, closed=None):
         addr = df.machine_listen_ports.get(machine)
@@ -262,6 +270,12 @@ async def _serve_connection(
                 df = daemon.dataflows.get(event.dataflow_id)
                 if df is not None:
                     daemon.migrate_node(df, event.node_id, event.handoff_dir)
+            elif isinstance(event, cm.ProfileDataflowNode):
+                df = daemon.dataflows.get(event.dataflow_id)
+                if df is not None:
+                    daemon.profile_node(
+                        df, event.node_id, event.action, event.seconds
+                    )
             elif isinstance(event, cm.LogsRequest):
                 df = daemon.dataflows.get(event.dataflow_id)
                 logs = b""

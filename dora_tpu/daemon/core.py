@@ -231,6 +231,10 @@ class Daemon:
         self.coordinator_notify: Callable[..., Any] | None = None
         #: optional sink for log lines (LogSubscribe streaming)
         self.log_sink: Callable[..., Any] | None = None
+        #: hook for attached mode: forward a node's finished deep-capture
+        #: artifact (n2d.ReportProfile) to the coordinator's waiting
+        #: StartProfile/StopProfile reply
+        self.profile_sink: Callable[..., Any] | None = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1282,6 +1286,19 @@ class Daemon:
                 )
             )
 
+    def profile_node(self, df: DataflowState, node_id: str, action: str,
+                     seconds: float) -> None:
+        """Ask a serving node to start/stop an on-demand deep profile
+        capture (cm.StartProfile/StopProfile)."""
+        queue = df.queues.get(node_id)
+        if queue is not None:
+            queue.push(
+                Timestamped(
+                    inner=d2n.Profile(action=action, seconds=seconds),
+                    timestamp=self.clock.new_timestamp(),
+                )
+            )
+
     # ------------------------------------------------------------------
     # logging
     # ------------------------------------------------------------------
@@ -1392,6 +1409,9 @@ class Daemon:
                 _extend_trace_buffer(df, node_id, msg.events)
             elif isinstance(msg, n2d.ReportServing):
                 df.node_serving[node_id] = msg.snapshot
+            elif isinstance(msg, n2d.ReportProfile):
+                if self.profile_sink is not None:
+                    self.profile_sink(df.id, node_id, msg.artifact, msg.error)
             elif isinstance(msg, n2d.P2PAnnounce):
                 df.p2p_listeners[node_id] = dict(msg.listeners)
                 await self._reply(conn, d2n.ReplyResult())

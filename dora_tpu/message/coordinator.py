@@ -115,6 +115,29 @@ class MigrateNode:
 
 
 @message
+class StartProfile:
+    """Start an on-demand deep profile capture (``jax.profiler.trace``)
+    on one serving node for ``seconds``, then reply with the artifact
+    path. Resolution mirrors MigrateNode; the reply waits for the
+    node's ReportProfile to round-trip through its daemon."""
+
+    dataflow_uuid: str | None
+    node_id: str
+    seconds: float = 5.0
+    name: str | None = None
+
+
+@message
+class StopProfile:
+    """Stop an in-flight capture early; replies with the artifact path
+    written so far."""
+
+    dataflow_uuid: str | None
+    node_id: str
+    name: str | None = None
+
+
+@message
 class LogSubscribe:
     """Turn this control connection into a live log stream for a dataflow."""
 
@@ -157,6 +180,14 @@ class NodeMigrated:
     uuid: str
     node_id: str
     handoff_dir: str
+
+
+@message
+class ProfileReply:
+    uuid: str
+    node_id: str
+    artifact: str  # capture directory, or the synthetic marker file
+    error: str | None = None
 
 
 @message
@@ -271,6 +302,14 @@ class MigrateDataflowNode:
 
 
 @message
+class ProfileDataflowNode:
+    dataflow_id: str
+    node_id: str
+    action: str  # "start" | "stop"
+    seconds: float = 0.0
+
+
+@message
 class LogsRequest:
     dataflow_id: str
     node_id: str
@@ -343,6 +382,14 @@ class LogsReplyFromDaemon:
     dataflow_id: str
     node_id: str
     logs: bytes
+
+
+@message
+class ProfileReplyFromDaemon:
+    dataflow_id: str
+    node_id: str
+    artifact: str
+    error: str | None = None
 
 
 @message

@@ -103,6 +103,16 @@ class Migrate:
 
 
 @message
+class Profile:
+    """Start ("start", for ``seconds``) or stop ("stop") an on-demand
+    deep profile capture on a serving node (coordinator StartProfile /
+    StopProfile flow). Non-serving nodes ignore it."""
+
+    action: str
+    seconds: float = 0.0
+
+
+@message
 class Input:
     id: str  # input DataId (namespaced "<op>/<input>" inside runtime nodes)
     metadata: Metadata
@@ -119,7 +129,9 @@ class AllInputsClosed:
     pass
 
 
-NodeEvent = Stop | Reload | Migrate | Input | InputClosed | AllInputsClosed
+NodeEvent = (
+    Stop | Reload | Migrate | Profile | Input | InputClosed | AllInputsClosed
+)
 
 
 # ---------------------------------------------------------------------------

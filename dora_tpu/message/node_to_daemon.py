@@ -102,6 +102,16 @@ class ReportServing:
 
 
 @message
+class ReportProfile:
+    """Deep-capture finished (or failed): the artifact path the serving
+    node produced, forwarded by the daemon to the coordinator's waiting
+    StartProfile/StopProfile reply (control channel, fire-and-forget)."""
+
+    artifact: str
+    error: str | None = None
+
+
+@message
 class NextDropEvents:
     """Blocking poll on the drop channel for released drop tokens (regions
     of ours that no receiver references anymore)."""
@@ -142,5 +152,7 @@ class P2PEdgesRequest:
 
 def expects_reply(request: Any) -> bool:
     return not isinstance(
-        request, (SendMessage, ReportDropTokens, ReportTrace, ReportServing)
+        request,
+        (SendMessage, ReportDropTokens, ReportTrace, ReportServing,
+         ReportProfile),
     )

@@ -188,6 +188,10 @@ class ServingMetrics:
         "prefix_hits", "prefix_misses", "prefix_hit_tokens",
         "prefix_cached_pages", "prefix_shared_pages",
         "prefix_cow_copies", "prefix_evictions",
+        "device_compute_ns", "host_dispatch_ns", "device_fetch_ns",
+        "dispatched_flops", "useful_flops",
+        "hbm_used_bytes", "hbm_limit_bytes", "hbm_peak_bytes",
+        "mfu", "device_busy_fraction",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -282,6 +286,28 @@ class ServingMetrics:
         self.prefix_shared_pages = 0
         self.prefix_cow_copies = 0
         self.prefix_evictions = 0
+        #: device utilization plane (dora_tpu.profiling, DORA_DEVICE_MONITOR):
+        #: cumulative window/chunk wall time attributed by the engine to
+        #: host dispatch vs device compute vs the device->host fetch (ns
+        #: counters fed on the step path), plus the FLOPs ledger — work
+        #: dispatched to the device vs work behind EMITTED tokens (the
+        #: two differ by speculation's rejected tails)
+        self.device_compute_ns = 0
+        self.host_dispatch_ns = 0
+        self.device_fetch_ns = 0
+        self.dispatched_flops = 0
+        self.useful_flops = 0
+        #: HBM gauges sampled off device.memory_stats() just before
+        #: snapshot; None when the backend exposes no allocator stats
+        #: (CPU) — the CLI renders dashes, prom exports 0
+        self.hbm_used_bytes: int | None = None
+        self.hbm_limit_bytes: int | None = None
+        self.hbm_peak_bytes: int | None = None
+        #: model FLOPs utilization over the last report interval
+        #: (useful_flops delta / wall / peak; None without a known peak)
+        #: and the fraction of wall time the device was computing
+        self.mfu: float | None = None
+        self.device_busy_fraction: float | None = None
 
     def snapshot(self) -> dict:
         import time
@@ -354,6 +380,16 @@ class ServingMetrics:
             "prefix_shared_pages": self.prefix_shared_pages,
             "prefix_cow_copies": self.prefix_cow_copies,
             "prefix_evictions": self.prefix_evictions,
+            "device_compute_ns": self.device_compute_ns,
+            "host_dispatch_ns": self.host_dispatch_ns,
+            "device_fetch_ns": self.device_fetch_ns,
+            "dispatched_flops": self.dispatched_flops,
+            "useful_flops": self.useful_flops,
+            "hbm_used_bytes": self.hbm_used_bytes,
+            "hbm_limit_bytes": self.hbm_limit_bytes,
+            "hbm_peak_bytes": self.hbm_peak_bytes,
+            "mfu": self.mfu,
+            "device_busy_fraction": self.device_busy_fraction,
         }
 
 

@@ -109,13 +109,23 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
                      "spec_drafted", "spec_accepted",
                      "shed", "preempted", "resumed", "retunes",
                      "prefix_hits", "prefix_misses", "prefix_hit_tokens",
-                     "prefix_cow_copies", "prefix_evictions"):
+                     "prefix_cow_copies", "prefix_evictions",
+                     "device_compute_ns", "host_dispatch_ns",
+                     "device_fetch_ns", "dispatched_flops",
+                     "useful_flops"):
             counters[f"srv:{node}:{name}"] = s.get(name, 0)
         for name in ("slots_active", "slots_total", "used_pages",
                      "total_pages", "free_pages", "backlog_depth",
                      "autotune_k", "prefix_cached_pages",
                      "prefix_shared_pages"):
             gauges[f"srv:{node}:{name}"] = s.get(name, 0)
+        # Device utilization gauges are None when unknown (CPU backend,
+        # monitor off, pre-round-16 snapshot): recorded only when real,
+        # so history series never fabricate a zero-MFU sample.
+        for name in ("mfu", "device_busy_fraction", "hbm_used_bytes",
+                     "hbm_limit_bytes", "hbm_peak_bytes"):
+            if s.get(name) is not None:
+                gauges[f"srv:{node}:{name}"] = s[name]
         for cls, d in (s.get("qos_depth") or {}).items():
             gauges[f"srv:{node}:qos_depth:{cls}"] = d
         ttft = s.get("ttft_us") or {}
@@ -415,10 +425,31 @@ def merge_history_snapshots(snapshots: list[dict]) -> dict:
         "dropped": dropped,
         "rates": derive_rates(samples),
         "percentiles": derive_percentiles(samples),
+        "util": derive_util(samples),
     }
     if slo:
         out["slo"] = slo
     return out
+
+
+_UTIL_GAUGES = ("mfu", "device_busy_fraction", "hbm_used_bytes",
+                "hbm_limit_bytes", "hbm_peak_bytes")
+
+
+def derive_util(samples: list[dict]) -> dict:
+    """Latest device-utilization gauges per serving node — the explicit
+    UTIL panel of ``dora-tpu top --json``. ``{node: {mfu: …, …}}``;
+    nodes (or whole histories) recorded before round 16 simply don't
+    appear — consumers render dashes, never zeros."""
+    util: dict[str, dict] = {}
+    for s in reversed(samples):
+        for key, val in s.get("gauges", {}).items():
+            if not key.startswith("srv:"):
+                continue
+            _, node, name = key.split(":", 2)
+            if name in _UTIL_GAUGES:
+                util.setdefault(node, {}).setdefault(name, val)
+    return util
 
 
 def _window(samples: list[dict], window_s: float = RATE_WINDOW_S) -> list[dict]:

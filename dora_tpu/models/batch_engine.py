@@ -36,6 +36,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from dora_tpu import profiling
+
 
 @dataclass
 class _Slot:
@@ -594,6 +596,26 @@ class PagedBatchEngine:
         #: quantization fix). Only fed while serving_metrics is attached,
         #: so the dict stays empty for raw-engine tests/benches.
         self.emit_lag_s: dict[str, float] = {}
+        #: device utilization plane (dora_tpu.profiling): when the
+        #: monitor is on, the step path splits each window/chunk's wall
+        #: time into host-dispatch / device-compute / device-fetch (a
+        #: block_until_ready between dispatch and the host read) and
+        #: keeps an analytic FLOPs ledger; the serving node turns the
+        #: interval deltas into mfu / device_busy_fraction gauges.
+        self.device_monitor = profiling.monitor_enabled()
+        self.host_dispatch_ns = 0
+        self.device_compute_ns = 0
+        self.device_fetch_ns = 0
+        #: FLOPs dispatched (every active row × K × (spec_k+1)) vs
+        #: useful (emitted tokens only) — the gap is frozen rows plus
+        #: speculation's rejected tails.
+        self.dispatched_flops = 0
+        self.useful_flops = 0
+        #: analytic per-token forward FLOPs (0 = model unknown: the
+        #: ledger stays zero and MFU renders as a dash) and the device's
+        #: peak FLOP/s for MFU's denominator — set by engine factories.
+        self.flops_per_token = 0
+        self.device_peak_flops = 0.0
 
         def _set_slot(tokens, positions, token, pos, b):
             tokens = jax.lax.dynamic_update_slice(
@@ -946,9 +968,23 @@ class PagedBatchEngine:
                 jnp.asarray(piece, jnp.int32), self.pools,
                 jnp.asarray(base, jnp.int32), jnp.asarray(self._bt[b]),
             )
+            t_disp = time.perf_counter()
             s.chunk_base = base + self.chunk
             self.chunks_run += 1
             self.dispatches += 1
+            if self.device_monitor:
+                self.host_dispatch_ns += int((t_disp - t_chunk) * 1e9)
+                if self.flops_per_token:
+                    self.dispatched_flops += self.chunk * self.flops_per_token
+                    self.useful_flops += (
+                        min(self.chunk, s.true_len - base)
+                        * self.flops_per_token
+                    )
+                if self.tracer is not None:
+                    self.tracer.span(
+                        "s_dev_dispatch", "chunk",
+                        dur_ns=int((t_disp - t_chunk) * 1e9),
+                    )
             final_chunk = s.chunk_base >= s.true_len
             if final_chunk:  # final chunk: stream starts
                 self._prefillq.popleft()
@@ -968,8 +1004,28 @@ class PagedBatchEngine:
                 # a python index would compile one slice per distinct
                 # prompt-length remainder.
                 t_fetch = time.perf_counter()
+                if self.device_monitor:
+                    # Non-final chunks stay async (their device time
+                    # surfaces as the next window's compute wait); the
+                    # final chunk must block for its first token anyway,
+                    # so split that wait into compute vs fetch here.
+                    greedy.block_until_ready()
+                    t_ready = time.perf_counter()
+                    self.device_compute_ns += int((t_ready - t_fetch) * 1e9)
+                    if self.tracer is not None:
+                        self.tracer.span(
+                            "s_dev_compute", "chunk",
+                            dur_ns=int((t_ready - t_fetch) * 1e9),
+                        )
                 token = int(np.asarray(greedy)[s.true_len - 1 - base])
                 t_first = time.perf_counter()
+                if self.device_monitor:
+                    self.device_fetch_ns += int((t_first - t_ready) * 1e9)
+                    if self.tracer is not None:
+                        self.tracer.span(
+                            "s_dev_fetch", "chunk",
+                            dur_ns=int((t_first - t_ready) * 1e9),
+                        )
                 self.fetches += 1
                 if sm is not None:
                     sm.fetch_latency.observe((t_first - t_fetch) * 1e6)
@@ -1078,9 +1134,39 @@ class PagedBatchEngine:
                 )
             self.dispatches += 1
             t_fetch = time.perf_counter()
+            if self.device_monitor:
+                self.host_dispatch_ns += int((t_fetch - t_win) * 1e9)
+                if self.tracer is not None:
+                    self.tracer.span(
+                        "s_dev_dispatch", "window",
+                        dur_ns=int((t_fetch - t_win) * 1e9),
+                    )
+                # Block BEFORE the host read so compute and transfer
+                # separate cleanly; np.asarray alone conflates them.
+                mat.block_until_ready()
+                t_ready = time.perf_counter()
+                self.device_compute_ns += int((t_ready - t_fetch) * 1e9)
+                if self.tracer is not None:
+                    self.tracer.span(
+                        "s_dev_compute", "window",
+                        dur_ns=int((t_ready - t_fetch) * 1e9),
+                    )
             host = np.asarray(mat)  # ONE [B, K+1] device->host transfer
             t_done = time.perf_counter()
             self.fetches += 1
+            if self.device_monitor:
+                self.device_fetch_ns += int((t_done - t_ready) * 1e9)
+                if self.tracer is not None:
+                    self.tracer.span(
+                        "s_dev_fetch", "window",
+                        dur_ns=int((t_done - t_ready) * 1e9),
+                    )
+                if self.flops_per_token:
+                    self.dispatched_flops += profiling.window_flops(
+                        flops_per_token=self.flops_per_token,
+                        active=sum(self._decode), k=self.window,
+                        spec_k=self.spec_k,
+                    )
             if sm is not None:
                 sm.fetch_latency.observe((t_done - t_fetch) * 1e6)
             if self.tracer is not None:
@@ -1109,6 +1195,7 @@ class PagedBatchEngine:
                         f"frozen_at={frozen}",
                         dur_ns=win_ns,
                     )
+            n_before = len(emitted)
             if self.spec_k:
                 self._unpack_spec(host, emitted, sm)
             else:
@@ -1138,6 +1225,13 @@ class PagedBatchEngine:
                         if done:
                             self._free_slot(b)
                             break
+            if self.device_monitor and self.flops_per_token:
+                # Useful work = tokens this window actually emitted;
+                # dispatched-minus-useful is the frozen-row + rejected-
+                # tail overhead MFU deliberately excludes.
+                self.useful_flops += (
+                    (len(emitted) - n_before) * self.flops_per_token
+                )
         if first_emit is not None:
             key, t_first = first_emit
             self.emit_lag_s[key] = time.perf_counter() - t_first
@@ -1354,7 +1448,9 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
                            cycle: int | None = None,
                            prefix_cache: bool = False,
                            prefix_cache_pages: int = 0,
-                           chunk_sleep_s: float = 0.0):
+                           chunk_sleep_s: float = 0.0,
+                           flops_per_token: int = 1_000_000,
+                           peak_flops: float = 1e12):
     """A weight-free :class:`PagedBatchEngine` over the REAL window
     machinery: the decode window is ``vlm.make_paged_window`` (the same
     ``lax.scan`` + ``freeze_inactive`` program serving runs) with the
@@ -1440,7 +1536,7 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
     else:
         chunk_fn = chunk_jit
 
-    return PagedBatchEngine(
+    engine = PagedBatchEngine(
         init_pool=lambda n: {"null": jnp.zeros((1,), jnp.int32)},
         chunk_prefill=chunk_fn,
         window_step=window_factory(window, spec_k),
@@ -1457,3 +1553,9 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         prefix_cache=prefix_cache,
         prefix_cache_pages=prefix_cache_pages,
     )
+    # Synthetic FLOPs constants so the utilization plane (MFU gauges,
+    # attribution spans, UTIL panels) is exercised end-to-end by tier-1
+    # on CPU: round numbers, so test expectations stay hand-checkable.
+    engine.flops_per_token = flops_per_token
+    engine.device_peak_flops = peak_flops
+    return engine

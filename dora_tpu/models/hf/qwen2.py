@@ -18,6 +18,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from dora_tpu import profiling
 from dora_tpu.models import layers as L
 from dora_tpu.models.hf.loader import (
     linear,
@@ -437,7 +438,7 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         ),
         donate_argnums=(1,),
     )
-    return PagedBatchEngine(
+    engine = PagedBatchEngine(
         init_pool=lambda n: init_page_pool(cfg, n, page_size),
         chunk_prefill=chunk_fn,
         window_step=window_fn,
@@ -454,6 +455,12 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         prefix_cache=prefix_cache,
         prefix_cache_pages=prefix_cache_pages,
     )
+    # Device utilization plane constants: the analytic per-token FLOPs
+    # of this config and the device's advertised peak, feeding the
+    # serving node's mfu / device_busy_fraction gauges.
+    engine.flops_per_token = profiling.flops_per_token_config(cfg)
+    engine.device_peak_flops = profiling.detect_peak_flops()
+    return engine
 
 
 def _lm(params, cfg: Qwen2Config, h, positions, mask, caches=None, cache_index=None):

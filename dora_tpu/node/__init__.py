@@ -381,6 +381,13 @@ class Node:
         self._control.queue(n2d.ReportServing(snapshot=dict(snapshot)))
         self._control.flush()
 
+    def report_profile(self, artifact: str, error: str | None = None) -> None:
+        """Report a finished deep-capture's artifact path (or failure)
+        to the daemon, fire-and-forget — it forwards to the
+        coordinator's waiting StartProfile/StopProfile reply."""
+        self._control.queue(n2d.ReportProfile(artifact=artifact, error=error))
+        self._control.flush()
+
     def allocate_sample(self, size: int) -> "DataSample":
         """Allocate a writable sample backed by a shared-memory region
         (reference: allocate_data_sample + DataSample,

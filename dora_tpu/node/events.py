@@ -286,6 +286,11 @@ class EventStream:
             return Event(
                 type="MIGRATE", metadata={"handoff_dir": inner.handoff_dir}
             )
+        if isinstance(inner, d2n.Profile):
+            return Event(
+                type="PROFILE",
+                metadata={"action": inner.action, "seconds": inner.seconds},
+            )
         return None
 
     def _queue_ack(self, token: str) -> None:

@@ -89,6 +89,7 @@ import time
 
 import pyarrow as pa
 
+from dora_tpu import profiling
 from dora_tpu.metrics import percentile_from_counts
 from dora_tpu.node import Node
 
@@ -332,8 +333,8 @@ class AdmissionQueue:
 
 def _run_loop(node, engine, backlog, metrics, handle_input, emit,
               report, clock=time.monotonic, on_tick=None, on_step=None,
-              handle_migrate=None, on_engine_error=None,
-              keep_alive=False) -> None:
+              handle_migrate=None, handle_profile=None,
+              on_engine_error=None, keep_alive=False) -> None:
     """Window-granular serving loop, factored out of :func:`main` so
     tests can drive it with fake nodes/engines. Each iteration: drain
     one event, run one engine step (one prefill chunk + one K-tick
@@ -380,6 +381,8 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
                 handle_input(event)
             elif event["type"] == "MIGRATE" and handle_migrate is not None:
                 handle_migrate(event)
+            elif event["type"] == "PROFILE" and handle_profile is not None:
+                handle_profile(event)
         if stop:
             break
         if (
@@ -907,6 +910,64 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             f"reason={reason} tpd={tpd:.2f}",
         )
 
+    # Device utilization plane (dora_tpu.profiling): HBM gauges sampled
+    # at report cadence, engine attribution/FLOPs counters copied into
+    # the snapshot, and mfu / device_busy_fraction derived from the
+    # interval deltas (reset-safe: a restored engine re-counts from
+    # zero, so a negative delta is treated as the whole interval).
+    monitor = (
+        profiling.DeviceMonitor() if profiling.monitor_enabled() else None
+    )
+    util_prev = {"busy_ns": 0, "flops": 0, "t": clock()}
+    # On-demand deep capture (cm.StartProfile/StopProfile): start arms
+    # a deadline checked at report cadence; stop (or the deadline)
+    # closes the capture and reports the artifact path to the daemon.
+    profile_state: dict = {
+        "active": False, "dir": "", "deadline": 0.0, "start_error": None,
+    }
+
+    def _finish_profile() -> None:
+        artifact = profiling.stop_capture(
+            profile_state["dir"], profile_state["start_error"]
+        )
+        profile_state["active"] = False
+        profile_state["start_error"] = None
+        tracer.instant("profile_stop", "(engine)", artifact)
+        try:
+            node.report_profile(artifact, None)
+        except Exception:
+            pass  # capture is best-effort; serving never blocks on it
+
+    def handle_profile(event) -> None:
+        md = event.get("metadata") or {}
+        action = md.get("action", "")
+        if action == "start":
+            if profile_state["active"]:
+                try:
+                    node.report_profile("", "capture already active")
+                except Exception:
+                    pass
+                return
+            out_dir = os.path.join(
+                profiling.profile_dir(),
+                f"capture-{os.getpid()}-{int(time.time())}",
+            )
+            profile_state["dir"] = out_dir
+            profile_state["start_error"] = profiling.start_capture(out_dir)
+            profile_state["active"] = True
+            profile_state["deadline"] = clock() + float(
+                md.get("seconds") or 0.0
+            )
+            tracer.instant("profile_start", "(engine)", out_dir)
+        elif action == "stop":
+            if profile_state["active"]:
+                _finish_profile()
+            else:
+                try:
+                    node.report_profile("", "no capture active")
+                except Exception:
+                    pass
+
     def report(now: float) -> None:
         metrics.slots_active = engine.active
         metrics.slots_total = engine.max_slots
@@ -936,6 +997,36 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 metrics.prefix_evictions = pc.evicted_pages
         metrics.qos_depth = backlog.depths()
         metrics.autotune_k = getattr(engine, "window", 0)
+        if monitor is not None:
+            metrics.device_compute_ns = getattr(engine, "device_compute_ns", 0)
+            metrics.host_dispatch_ns = getattr(engine, "host_dispatch_ns", 0)
+            metrics.device_fetch_ns = getattr(engine, "device_fetch_ns", 0)
+            metrics.dispatched_flops = getattr(engine, "dispatched_flops", 0)
+            metrics.useful_flops = getattr(engine, "useful_flops", 0)
+            mem = monitor.memory()
+            metrics.hbm_used_bytes = mem["used"]
+            metrics.hbm_limit_bytes = mem["limit"]
+            metrics.hbm_peak_bytes = mem["peak"]
+            dt = now - util_prev["t"]
+            if dt > 0:
+                d_busy = metrics.device_compute_ns - util_prev["busy_ns"]
+                if d_busy < 0:  # engine restored: counters restarted at 0
+                    d_busy = metrics.device_compute_ns
+                metrics.device_busy_fraction = min(
+                    1.0, max(0.0, d_busy / (dt * 1e9))
+                )
+                d_flops = metrics.useful_flops - util_prev["flops"]
+                if d_flops < 0:
+                    d_flops = metrics.useful_flops
+                peak = getattr(engine, "device_peak_flops", 0.0)
+                metrics.mfu = (
+                    min(1.0, (d_flops / dt) / peak) if peak > 0 else None
+                )
+            util_prev["busy_ns"] = metrics.device_compute_ns
+            util_prev["flops"] = metrics.useful_flops
+            util_prev["t"] = now
+        if profile_state["active"] and now >= profile_state["deadline"]:
+            _finish_profile()
         check_slo(now)
         autotune(now)
         try:
@@ -1250,6 +1341,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             on_tick=on_tick if recovery_on else None,
             on_step=on_step if ckpt_dir else None,
             handle_migrate=handle_migrate if can_ckpt else None,
+            handle_profile=handle_profile,
             on_engine_error=on_engine_error,
             keep_alive=bool(migrate_dir),
         )

@@ -66,6 +66,16 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_serving_prefix_evictions_total": ("counter", "Cached prefix pages evicted under pool pressure"),
     "dora_serving_prefix_cached_pages": ("gauge", "KV pages held by the radix prefix cache"),
     "dora_serving_prefix_shared_pages": ("gauge", "Cached pages currently mapped shared into live streams"),
+    "dora_tpu_mfu": ("gauge", "Model FLOPs utilization: useful (emitted-token) FLOP/s over device peak"),
+    "dora_tpu_device_busy_fraction": ("gauge", "Fraction of wall time the device spent computing dispatched windows"),
+    "dora_tpu_device_hbm_used_bytes": ("gauge", "Device allocator bytes in use (0 when the backend exposes no memory stats)"),
+    "dora_tpu_device_hbm_limit_bytes": ("gauge", "Device allocator byte limit"),
+    "dora_tpu_device_hbm_peak_bytes": ("gauge", "Device allocator peak bytes in use"),
+    "dora_tpu_device_compute_ns_total": ("counter", "Device-compute nanoseconds attributed across fused windows and final prefill chunks"),
+    "dora_tpu_device_host_dispatch_ns_total": ("counter", "Host-side dispatch nanoseconds before each device launch"),
+    "dora_tpu_device_fetch_ns_total": ("counter", "Device-to-host fetch nanoseconds after each window"),
+    "dora_tpu_device_flops_total": ("counter", "Useful FLOPs: emitted tokens x analytic per-token model"),
+    "dora_tpu_device_dispatched_flops_total": ("counter", "Dispatched FLOPs including frozen rows and rejected speculative tails"),
 }
 
 #: (snapshot serving key, metric family) pairs for the per-node scalars
@@ -85,6 +95,11 @@ _SERVING_COUNTERS = (
     ("prefix_hit_tokens", "dora_serving_prefix_hit_tokens_total"),
     ("prefix_cow_copies", "dora_serving_prefix_cow_copies_total"),
     ("prefix_evictions", "dora_serving_prefix_evictions_total"),
+    ("device_compute_ns", "dora_tpu_device_compute_ns_total"),
+    ("host_dispatch_ns", "dora_tpu_device_host_dispatch_ns_total"),
+    ("device_fetch_ns", "dora_tpu_device_fetch_ns_total"),
+    ("useful_flops", "dora_tpu_device_flops_total"),
+    ("dispatched_flops", "dora_tpu_device_dispatched_flops_total"),
 )
 _SERVING_GAUGES = (
     ("slots_active", "dora_serving_slots_active"),
@@ -96,6 +111,14 @@ _SERVING_GAUGES = (
     ("autotune_k", "dora_serving_autotune_k"),
     ("prefix_cached_pages", "dora_serving_prefix_cached_pages"),
     ("prefix_shared_pages", "dora_serving_prefix_shared_pages"),
+    # Device utilization gauges: None (backend exposes no stats /
+    # monitor off) exports as 0 via the `or 0` in iter_samples — prom
+    # has no "absent" value; the CLIs render the dash instead.
+    ("mfu", "dora_tpu_mfu"),
+    ("device_busy_fraction", "dora_tpu_device_busy_fraction"),
+    ("hbm_used_bytes", "dora_tpu_device_hbm_used_bytes"),
+    ("hbm_limit_bytes", "dora_tpu_device_hbm_limit_bytes"),
+    ("hbm_peak_bytes", "dora_tpu_device_hbm_peak_bytes"),
 )
 
 
@@ -340,6 +363,16 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "prefix_evictions": 6,
                     "prefix_cached_pages": 20,
                     "prefix_shared_pages": 9,
+                    "device_compute_ns": 900_000_000,
+                    "host_dispatch_ns": 80_000_000,
+                    "device_fetch_ns": 20_000_000,
+                    "useful_flops": 4_096_000_000,
+                    "dispatched_flops": 16_384_000_000,
+                    "mfu": 0.41,
+                    "device_busy_fraction": 0.9,
+                    "hbm_used_bytes": 12 << 30,
+                    "hbm_limit_bytes": 16 << 30,
+                    "hbm_peak_bytes": 13 << 30,
                     "qos_depth": {"interactive": 0, "standard": 1, "batch": 3},
                     "ttft_us": hist.snapshot(),
                 }

@@ -343,6 +343,61 @@ def _spec_ab() -> dict:
     return out
 
 
+def _profiling_ab() -> dict:
+    """Device-monitor A/B behind ``--profiling-ab``: the round-16
+    utilization plane (per-window ``block_until_ready`` attribution +
+    FLOPs ledger) on vs off at 16 streams on the stub paged engine,
+    trials interleaved — the ``_trace_ab`` methodology applied to the
+    monitor flag. ONE engine serves both sides with
+    ``engine.device_monitor`` toggled between serves (exactly what
+    ``DORA_DEVICE_MONITOR`` controls): a fresh engine per side measures
+    construction variance — allocator layout, first-touch page faults,
+    build-order bias worth ~3-5% on a run this short — instead of the
+    monitor. The estimator is the **median of per-trial paired ratios**:
+    each trial's off/on serves run back-to-back (~tens of ms apart), so
+    slow ambient drift — a busy CI host speeding up or bogging down over
+    the run — hits both legs of a pair equally and divides out, where a
+    pooled off-median vs on-median comparison would charge it to
+    whichever side the drift happened to land on. The gate is <= 3%
+    wall-clock overhead — same bar as the serving-trace recorder,
+    because the plane is default-on."""
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    max_seq, page_size, chunk, max_new, streams = 256, 8, 16, 192, 16
+    prompts = [[i + 5] for i in range(streams)]
+    trials = int(os.environ.get("DORA_BENCH_TRIALS", "14"))
+    engine = make_stub_paged_engine(
+        max_slots=streams, max_seq=max_seq, page_size=page_size,
+        chunk=chunk, window=8,
+    )
+    _serve(engine, prompts, 4)  # warmup: compile only
+    walls: dict[str, list[float]] = {"off": [], "on": []}
+    for i in range(trials):
+        # Alternate pair order so first-in-pair warmth cancels instead
+        # of biasing one side.
+        for mode in (("off", "on") if i % 2 == 0 else ("on", "off")):
+            engine.device_monitor = mode == "on"
+            _, wall, _ = _serve(engine, prompts, max_new)
+            walls[mode].append(wall)
+    engine.device_monitor = True
+    ratios = [
+        on / off
+        for off, on in zip(walls["off"], walls["on"])
+        if off > 0
+    ]
+    overhead = (statistics.median(ratios) - 1.0) * 100.0 if ratios else 0.0
+    return {
+        "streams": streams,
+        "max_new": max_new,
+        "trials": trials,
+        "monitor_off_wall_s": round(statistics.median(walls["off"]), 4),
+        "monitor_on_wall_s": round(statistics.median(walls["on"]), 4),
+        "overhead_pct": round(overhead, 2),
+        "gate_pct": 3.0,
+        "pass": overhead <= 3.0,
+    }
+
+
 class _OpenLoopNode:
     """Node fake feeding serve() a pre-scheduled open-loop arrival
     trace: recv() releases an event once its arrival time has passed —
@@ -682,6 +737,11 @@ def main() -> int:
         # Stub-engine leg: no checkpoint needed, acceptance is shaped
         # by the token rule, not model weights.
         print(json.dumps({"spec_ab": _spec_ab()}))
+        return 0
+    if "--profiling-ab" in sys.argv[1:]:
+        # Stub-engine leg: the monitor's cost is per-window host work
+        # (block_until_ready + counter math), independent of weights.
+        print(json.dumps({"profiling_ab": _profiling_ab()}))
         return 0
     path = os.environ.get("DORA_HF_CHECKPOINT")
     real = bool(path)
