@@ -434,6 +434,49 @@ def tracing_ab_leg() -> dict:
     }
 
 
+
+def lockcheck_ab_leg() -> dict:
+    """Lock-order detector A/B on the daemon route: DORA_LOCKCHECK=0 vs
+    =1, runs interleaved so both sides see the same machine conditions.
+    The =0 side is the production default — the tracked_lock factories
+    hand back plain threading.Lock objects at construction, so the
+    budget for the disabled detector is ≤3% on msgs_per_sec (really:
+    noise). The =1 side prices per-acquire order recording + the
+    blocking probes, and is reported, not gated (it is a debug mode)."""
+    off: list[float] = []
+    on: list[float] = []
+    for i in range(SMALL_RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-lck-") as tmp:
+            off.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={"DORA_LOCKCHECK": "0"},
+                )["msgs_per_sec"]
+            )
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-lck-") as tmp:
+            on.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={"DORA_LOCKCHECK": "1",
+                               "DORA_LOCKCHECK_REPORT": "0"},
+                )["msgs_per_sec"]
+            )
+        print(
+            f"# lockcheck A/B run {i + 1}/{SMALL_RUNS}: "
+            f"off {off[-1]:.0f} msg/s, on {on[-1]:.0f} msg/s",
+            file=sys.stderr,
+        )
+    off_m = statistics.median(off)
+    on_m = statistics.median(on)
+    return {
+        "off_msgs_per_sec": round(off_m, 0),
+        "on_msgs_per_sec": round(on_m, 0),
+        "on_overhead_pct": (
+            round((off_m - on_m) / off_m * 100, 2) if off_m else None
+        ),
+    }
+
+
 def history_prom_ab_leg() -> dict:
     """Time-series-plane A/B on the daemon route: history sampling off
     (DORA_METRICS_HISTORY_S=0) vs on at an aggressive 0.5 s cadence with
@@ -877,6 +920,16 @@ def main() -> int:
         }
 
     try:
+        lockcheck_ab = lockcheck_ab_leg()
+    except Exception as exc:
+        lockcheck_ab = {
+            "off_msgs_per_sec": None,
+            "on_msgs_per_sec": None,
+            "on_overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         history_prom_ab = history_prom_ab_leg()
     except Exception as exc:
         history_prom_ab = {
@@ -986,6 +1039,7 @@ def main() -> int:
         "small_msg_detail": small,
         "recorder_ab": recorder_ab,
         "tracing_ab": tracing_ab,
+        "lockcheck_ab": lockcheck_ab,
         "history_prom_ab": history_prom_ab,
         "serving_engine_ab": engine_ab,
         "serving_multistep_ab": multistep_ab,

@@ -1,0 +1,59 @@
+"""Static/dynamic analysis plane (``dora-tpu lint`` / ``dora-tpu check``).
+
+The native tier runs under ASan/TSan (tests/test_sanitizers.py); this
+package is the correctness tooling for the Python control/data plane:
+
+* :mod:`dora_tpu.analysis.lockcheck` — a lock-order race detector.
+  ``tracked_lock()`` drop-ins record per-thread acquisition order into a
+  process-wide graph when ``DORA_LOCKCHECK=1`` (a plain
+  ``threading.Lock`` otherwise), reporting order-graph cycles (potential
+  ABBA deadlocks), locks held across blocking calls, and long holds.
+* :mod:`dora_tpu.analysis.graphcheck` — deploy-time dataflow descriptor
+  checks (``dora-tpu check``): unbuffered cycles, dangling/duplicate
+  edges, restart×p2p and qos/slo contradictions, promoted from runtime
+  vetoes to machine-readable diagnostics.
+* :mod:`dora_tpu.analysis.jaxlint` — AST lint over models/ and ops/ for
+  recompile hazards: Python branches on traced values inside jit,
+  unhashable static args, missing ``donate_argnums`` on pool-carrying
+  jits, wall-clock/RNG calls under trace.
+* :mod:`dora_tpu.analysis.envreg` — the central ``DORA_*`` env-var
+  registry plus lints that every env read is declared and the README
+  tables match.
+* :mod:`dora_tpu.analysis.wirecheck` — serde coverage: every
+  ``@message`` type has a compiled codec and golden-file coverage.
+
+All passes emit :class:`Finding` so ``dora-tpu lint --json`` has one
+machine-readable shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class Finding:
+    """One diagnostic from any analysis pass.
+
+    ``code`` is stable and machine-matchable (e.g. ``lock-cycle``,
+    ``graph-unbuffered-cycle``, ``jax-tracer-branch``, ``env-undeclared``);
+    ``level`` is ``error`` or ``warning``; ``where`` locates the finding
+    (``path:line`` for source passes, a node/lock name for the others).
+    """
+
+    pass_name: str
+    code: str
+    level: str
+    where: str
+    message: str
+    detail: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def render(self) -> str:
+        return f"[{self.pass_name}] {self.level} {self.code} {self.where}: {self.message}"
+
+
+def errors(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if f.level == "error"]

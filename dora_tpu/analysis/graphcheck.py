@@ -1,0 +1,349 @@
+"""Deploy-time dataflow graph checks (``dora-tpu check``).
+
+Absorbs and extends :mod:`dora_tpu.core.validate`: the source/edge
+validation stays (and still guards the runtime start path), while the
+contradictions that used to be runtime vetoes or silent fallbacks become
+deploy-time diagnostics with machine-readable codes:
+
+* ``graph-invalid`` — anything :func:`core.validate.check_dataflow`
+  rejects (unresolvable sources, inputs to undeclared outputs).
+* ``graph-dangling-edge`` / ``graph-duplicate-edge`` /
+  ``graph-duplicate-node`` — structural edge problems, ALL of them
+  (validate raises on the first).
+* ``graph-cycle-deadlock`` — a cycle of user-mapped edges with no timer
+  input, no input from outside the cycle anywhere in its strongly
+  connected component, and no node driven by events from outside the
+  dataflow entirely (an HTTP front door, a keyboard, a sensor):
+  nothing ever produces the first message, so the loop is deadlocked
+  at startup. (Full queues cannot deadlock here — the daemon drops
+  oldest — so the startup form is the real one.)
+* ``graph-restart-p2p`` — a restartable node receiving p2p-eligible
+  edges under an explicit ``DORA_P2P: "1"``. The daemon silently keeps
+  such receivers daemon-routed (daemon/core.py ``_compute_p2p``: crash
+  replay needs the daemon-held in-flight window); an explicit opt-in
+  that cannot be honored is a descriptor contradiction.
+* ``graph-slo-non-serving`` — ``slo:`` serving targets (ttft,
+  tokens/s) on a node that reports no serving metrics; the burn-rate
+  gauges would read forever-zero and the SLO silently never fires.
+* ``graph-qos-non-serving`` — ``qos:`` on a node with no admission
+  queue to shape.
+* ``graph-qos-deadline-quantum`` — ``shed_wait_ms`` below the fused
+  decode window quantum (``DORA_MULTISTEP_K`` steps): every queued
+  request sheds before one window can complete.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from dora_tpu.analysis import Finding
+from dora_tpu.core.config import TimerMapping, UserMapping
+from dora_tpu.core.descriptor import CustomNode, Descriptor
+
+#: Node-hub sources that run a serving engine and therefore report the
+#: SERVING metrics the slo/qos planes consume.
+SERVING_SOURCES = ("llm_server",)
+
+#: Node-hub sources whose main loop is driven by events from OUTSIDE
+#: the dataflow (HTTP requests, keystrokes, sensor frames, recorded
+#: logs). Such a node produces output without first receiving a
+#: dataflow input, so a cycle through one is not startup-deadlocked —
+#: the external world injects the first message.
+EXTERNAL_INGRESS_SOURCES = (
+    "openai_server",
+    "llm_server",
+    "keyboard",
+    "terminal_input",
+    "microphone",
+    "camera",
+    "replay",
+)
+
+#: Floor for one fused decode window, per step (conservative: CPU stub
+#: engines tick ~1 ms/step; real engines are slower).
+_MS_PER_STEP_FLOOR = 1.0
+
+
+def _is_serving(node) -> bool:
+    kind = node.kind
+    return isinstance(kind, CustomNode) and any(
+        s in str(kind.source) for s in SERVING_SOURCES
+    )
+
+
+def _has_external_ingress(node) -> bool:
+    kind = node.kind
+    return isinstance(kind, CustomNode) and any(
+        s in str(kind.source) for s in EXTERNAL_INGRESS_SOURCES
+    )
+
+
+def _env_truthy(value) -> bool:
+    return str(value) not in ("", "0", "None", "False", "false")
+
+
+def check_descriptor(
+    descriptor: Descriptor, working_dir: str | Path | None = None
+) -> list[Finding]:
+    """All deploy-time diagnostics for one parsed descriptor."""
+    out: list[Finding] = []
+    out += _structural(descriptor, working_dir)
+    out += _cycle_deadlocks(descriptor)
+    out += _restart_p2p(descriptor)
+    out += _qos_slo(descriptor)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# structure
+# ---------------------------------------------------------------------------
+
+
+def _structural(descriptor, working_dir) -> list[Finding]:
+    from dora_tpu.core.validate import ValidationError, check_dataflow
+
+    out: list[Finding] = []
+    try:
+        check_dataflow(descriptor, working_dir)
+    except ValidationError as e:
+        out.append(Finding(
+            "graphcheck", "graph-invalid", "error", "dataflow", str(e)
+        ))
+
+    seen_ids: set[str] = set()
+    for node in descriptor.nodes:
+        nid = str(node.id)
+        if nid in seen_ids:
+            out.append(Finding(
+                "graphcheck", "graph-duplicate-node", "error", nid,
+                f"node id {nid!r} declared more than once",
+            ))
+        seen_ids.add(nid)
+
+    node_ids = {str(n.id) for n in descriptor.nodes}
+    declared = descriptor.output_ids()
+    for node in descriptor.nodes:
+        by_source: dict[str, list[str]] = {}
+        for input_id, inp in node.inputs.items():
+            m = inp.mapping
+            if isinstance(m, TimerMapping):
+                continue
+            if str(m.source) not in node_ids:
+                out.append(Finding(
+                    "graphcheck", "graph-dangling-edge", "error",
+                    f"{node.id}/{input_id}",
+                    f"source node {str(m.source)!r} does not exist",
+                ))
+            elif m.output_id not in declared:
+                out.append(Finding(
+                    "graphcheck", "graph-dangling-edge", "error",
+                    f"{node.id}/{input_id}",
+                    f"node {str(m.source)!r} has no output {str(m.output)!r}",
+                ))
+            by_source.setdefault(str(m), []).append(str(input_id))
+        for source, inputs in by_source.items():
+            if len(inputs) > 1:
+                out.append(Finding(
+                    "graphcheck", "graph-duplicate-edge", "warning",
+                    f"{node.id}",
+                    f"output {source!r} feeds {len(inputs)} inputs of the "
+                    f"same node ({', '.join(sorted(inputs))}) — each message "
+                    "is delivered twice",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# startup-deadlocked cycles
+# ---------------------------------------------------------------------------
+
+
+def _cycle_deadlocks(descriptor) -> list[Finding]:
+    node_ids = {str(n.id) for n in descriptor.nodes}
+    edges: dict[str, set[str]] = {nid: set() for nid in node_ids}
+    has_timer: set[str] = set()
+    external_ingress = {
+        str(n.id) for n in descriptor.nodes if _has_external_ingress(n)
+    }
+    for node in descriptor.nodes:
+        nid = str(node.id)
+        for _input_id, inp in node.inputs.items():
+            m = inp.mapping
+            if isinstance(m, TimerMapping):
+                has_timer.add(nid)
+            elif isinstance(m, UserMapping) and str(m.source) in node_ids:
+                edges[str(m.source)].add(nid)
+
+    out: list[Finding] = []
+    for scc in _tarjan_sccs(edges):
+        internal = any(b in scc for a in scc for b in edges.get(a, ()))
+        if not internal:
+            continue  # not a cycle
+        if any(n in has_timer for n in scc):
+            continue  # a timer drives the loop
+        if any(n in external_ingress for n in scc):
+            continue  # an HTTP front door / sensor injects the first message
+        fed_externally = False
+        for node in descriptor.nodes:
+            if str(node.id) not in scc:
+                continue
+            for inp in node.inputs.values():
+                m = inp.mapping
+                if isinstance(m, UserMapping) and str(m.source) not in scc:
+                    fed_externally = True
+        if fed_externally:
+            continue
+        members = sorted(scc)
+        out.append(Finding(
+            "graphcheck", "graph-cycle-deadlock", "error",
+            " -> ".join(members),
+            "cycle has no timer input and no input from outside the loop — "
+            "no node can ever produce the first message",
+            {"nodes": members},
+        ))
+    return out
+
+
+def _tarjan_sccs(edges: dict[str, set[str]]) -> list[set[str]]:
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[set[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # Iterative Tarjan: recursion would overflow on long chains.
+        work = [(v, iter(sorted(edges.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(edges):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# ---------------------------------------------------------------------------
+# restart × p2p
+# ---------------------------------------------------------------------------
+
+
+def _restart_p2p(descriptor) -> list[Finding]:
+    global_env = (descriptor.raw or {}).get("env") or {}
+    out: list[Finding] = []
+    for node in descriptor.nodes:
+        if node.restart is None:
+            continue
+        p2p_requested = None
+        if "DORA_P2P" in node.env:
+            p2p_requested = _env_truthy(node.env["DORA_P2P"])
+        elif "DORA_P2P" in global_env:
+            p2p_requested = _env_truthy(global_env["DORA_P2P"])
+        if not p2p_requested:
+            continue  # default-on p2p silently falls back; only an
+            # EXPLICIT opt-in is a contradiction
+        receives = [
+            str(input_id)
+            for input_id, inp in node.inputs.items()
+            if isinstance(inp.mapping, UserMapping)
+        ]
+        if receives:
+            out.append(Finding(
+                "graphcheck", "graph-restart-p2p", "error", str(node.id),
+                "restart: requires daemon-routed inputs (crash replay holds "
+                "the un-acked window in the daemon), but the descriptor "
+                "explicitly sets DORA_P2P=1 for this node — the opt-in "
+                f"cannot be honored for inputs {', '.join(sorted(receives))}",
+                {"inputs": sorted(receives)},
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# qos / slo contradictions
+# ---------------------------------------------------------------------------
+
+
+def _qos_slo(descriptor) -> list[Finding]:
+    global_env = (descriptor.raw or {}).get("env") or {}
+    out: list[Finding] = []
+    for node in descriptor.nodes:
+        serving = _is_serving(node)
+        slo = node.slo
+        if slo is not None and not serving:
+            targets = [
+                k for k in ("ttft_p99_ms", "tokens_per_s_min")
+                if getattr(slo, k) is not None
+            ]
+            if targets:
+                out.append(Finding(
+                    "graphcheck", "graph-slo-non-serving", "error",
+                    str(node.id),
+                    f"slo targets {', '.join(targets)} need SERVING metrics, "
+                    "which this node never reports — the objective would "
+                    "silently never fire",
+                    {"targets": targets},
+                ))
+        qos = node.qos
+        if qos is None:
+            continue
+        if not serving:
+            out.append(Finding(
+                "graphcheck", "graph-qos-non-serving", "error", str(node.id),
+                "qos: shapes a serving admission queue, which this node "
+                "does not run",
+            ))
+            continue
+        if qos.shed_wait_ms is not None and qos.shed_wait_ms > 0:
+            raw_k = node.env.get(
+                "DORA_MULTISTEP_K", global_env.get("DORA_MULTISTEP_K", 8)
+            )
+            try:
+                k = max(1, int(str(raw_k)))
+            except ValueError:
+                k = 8
+            quantum_ms = k * _MS_PER_STEP_FLOOR
+            if qos.shed_wait_ms < quantum_ms:
+                out.append(Finding(
+                    "graphcheck", "graph-qos-deadline-quantum", "error",
+                    str(node.id),
+                    f"shed_wait_ms={qos.shed_wait_ms:g} is below the fused "
+                    f"decode window quantum (~{quantum_ms:g} ms at "
+                    f"DORA_MULTISTEP_K={k}) — every queued request sheds "
+                    "before one window completes",
+                    {"shed_wait_ms": qos.shed_wait_ms,
+                     "quantum_ms": quantum_ms, "k": k},
+                ))
+    return out

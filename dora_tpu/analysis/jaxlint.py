@@ -1,0 +1,359 @@
+"""JAX recompile-hazard lint (AST pass over models/ and ops/).
+
+The serving engine's zero-steady-state-compile invariant (one XLA
+program per closure, tests/test_paged_engine.py) dies by a thousand
+cuts: a Python ``if`` on a traced value retraces per branch, an
+unhashable static arg retraces per call, a missing ``donate_argnums``
+on a pool-carrying jit doubles HBM, and a wall-clock/RNG call under
+trace bakes one sample into the compiled program forever. This pass
+catches all four shapes *before* runtime — the runtime compile-count
+guard (telemetry.install_compile_listener) only fires after the damage.
+
+Syntactic by design (KNOWN_ISSUES round 17): it sees functions defined
+and jitted in the same module (decorator form ``@partial(jax.jit,
+static_argnums=...)`` / ``@jax.jit``, and call form ``jax.jit(fn,
+...)`` where ``fn`` is a module-local def or lambda). Closure-captured
+tracers and dynamically built jits escape it; the runtime guard remains
+the backstop.
+
+Codes:
+
+* ``jax-tracer-branch`` — ``if``/``while`` whose test uses a traced
+  parameter's *value*. Shape/dtype/ndim/size access, ``len()``,
+  ``isinstance()`` and ``is (not) None`` tests are concrete at trace
+  time and exempt.
+* ``jax-unhashable-static`` — a static argument whose default is a
+  list/dict/set literal (retrace or TypeError per call).
+* ``jax-missing-donate`` — a jitted function carrying a KV pool
+  parameter (named ``pools``) without donating it: the old pool stays
+  alive across the call, doubling page memory.
+* ``jax-impure-call`` — ``time.*`` / ``random.*`` / ``np.random.*``
+  inside a jitted body (``jax.random`` is the supported path).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from dora_tpu.analysis import Finding
+
+#: Attribute reads on a tracer that are concrete at trace time.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "at"}
+
+#: Parameter names that carry donated KV pools in this codebase.
+_POOL_PARAMS = {"pools"}
+
+_TIME_CALLS = {"time", "time_ns", "monotonic", "monotonic_ns",
+               "perf_counter", "perf_counter_ns"}
+
+
+def _is_jax_jit(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "jit"
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "jax"
+    ) or (isinstance(expr, ast.Name) and expr.id == "jit")
+
+
+def _const_int_tuple(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _const_str_tuple(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            elt.value for elt in node.elts
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+        ]
+    return []
+
+
+class _JitSite:
+    """One jit application: the target function plus the jit kwargs."""
+
+    def __init__(self, fn, call: ast.Call | None, lineno: int):
+        self.fn = fn  # ast.FunctionDef | ast.Lambda
+        self.lineno = lineno
+        self.static_nums: list[int] = []
+        self.static_names: list[str] = []
+        self.donates = False
+        if call is not None:
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    self.static_nums = _const_int_tuple(kw.value)
+                elif kw.arg == "static_argnames":
+                    self.static_names = _const_str_tuple(kw.value)
+                elif kw.arg in ("donate_argnums", "donate_argnames"):
+                    self.donates = True
+
+    def params(self) -> list[ast.arg]:
+        a = self.fn.args
+        return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+    def traced_params(self) -> set[str]:
+        params = self.params()
+        static = set(self.static_names)
+        for i in self.static_nums:
+            if 0 <= i < len(params):
+                static.add(params[i].arg)
+        return {p.arg for p in params} - static
+
+    def static_params(self) -> set[str]:
+        return {p.arg for p in self.params()} - self.traced_params()
+
+
+def _collect_sites(tree: ast.Module) -> list[_JitSite]:
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    sites: list[_JitSite] = []
+    jitted_defs: set[int] = set()
+
+    # Decorator form.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if _is_jax_jit(dec):
+                sites.append(_JitSite(node, None, node.lineno))
+                jitted_defs.add(id(node))
+            elif isinstance(dec, ast.Call):
+                target = None
+                if _is_jax_jit(dec.func):
+                    target = dec
+                elif (
+                    (isinstance(dec.func, ast.Name)
+                     and dec.func.id == "partial")
+                    or (isinstance(dec.func, ast.Attribute)
+                        and dec.func.attr == "partial")
+                ) and dec.args and _is_jax_jit(dec.args[0]):
+                    target = dec
+                if target is not None:
+                    sites.append(_JitSite(node, target, node.lineno))
+                    jitted_defs.add(id(node))
+
+    # Call form: jax.jit(fn, ...) with fn a module-local def or lambda.
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jax_jit(node.func)
+                and node.args):
+            continue
+        target = node.args[0]
+        fn = None
+        if isinstance(target, ast.Name):
+            fn = defs.get(target.id)
+            if fn is not None and id(fn) in jitted_defs:
+                fn = None  # decorator form already covers it
+        elif isinstance(target, ast.Lambda):
+            fn = target
+        if isinstance(fn, (ast.FunctionDef, ast.Lambda)):
+            sites.append(_JitSite(fn, node, node.lineno))
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# per-site checks
+# ---------------------------------------------------------------------------
+
+
+def _value_uses(expr: ast.AST, traced: set[str]) -> list[ast.Name]:
+    """Name nodes inside ``expr`` whose runtime *value* is a tracer.
+
+    Prunes subtrees that are concrete at trace time: static attribute
+    reads (``x.shape[0]``), ``len(x)``, ``isinstance(x, ...)``, and
+    identity tests against None.
+    """
+    if isinstance(expr, ast.Name):
+        return [expr] if expr.id in traced else []
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return []
+        return _value_uses(expr.value, traced)
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Name) and expr.func.id in (
+            "len", "isinstance", "hasattr", "getattr", "type",
+        ):
+            return []
+        out = []
+        for arg in list(expr.args) + [kw.value for kw in expr.keywords]:
+            out.extend(_value_uses(arg, traced))
+        # The callee itself (e.g. ``x.sum`` with x traced).
+        out.extend(_value_uses(expr.func, traced))
+        return out
+    if isinstance(expr, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return []
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in expr.ops):
+            # Membership on a traced param is a dict-pytree key probe in
+            # this codebase ("mid_pos" in params) — concrete at trace
+            # time. `x in array` WOULD be a hazard; accepted blind spot
+            # of the syntactic pass (module docstring).
+            return []
+        out = _value_uses(expr.left, traced)
+        for comp in expr.comparators:
+            out.extend(_value_uses(comp, traced))
+        return out
+    out = []
+    for child in ast.iter_child_nodes(expr):
+        out.extend(_value_uses(child, traced))
+    return out
+
+
+def _lint_site(site: _JitSite, rel: str) -> list[Finding]:
+    out: list[Finding] = []
+    traced = site.traced_params()
+    fn_name = getattr(site.fn, "name", "<lambda>")
+
+    # Shadowing: a param rebound in the body stops being the tracer we
+    # reason about — drop it (syntactic pass, stay conservative).
+    live = set(traced)
+    for node in ast.walk(site.fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            targets = [node.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    live.discard(n.id)
+
+    for node in ast.walk(site.fn):
+        if isinstance(node, (ast.If, ast.While)):
+            uses = _value_uses(node.test, live)
+            if uses:
+                names = sorted({u.id for u in uses})
+                out.append(Finding(
+                    "jaxlint", "jax-tracer-branch", "error",
+                    f"{rel}:{node.test.lineno}",
+                    f"{fn_name}: Python "
+                    f"{'if' if isinstance(node, ast.If) else 'while'} "
+                    f"branches on traced value(s) {', '.join(names)} — "
+                    "retraces per branch; use lax.cond/select or "
+                    "static_argnums",
+                    {"fn": fn_name, "params": names},
+                ))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name
+            ):
+                mod = func.value.id
+                if mod == "time" and func.attr in _TIME_CALLS:
+                    out.append(Finding(
+                        "jaxlint", "jax-impure-call", "error",
+                        f"{rel}:{node.lineno}",
+                        f"{fn_name}: time.{func.attr}() under jit is baked "
+                        "into the compiled program",
+                        {"fn": fn_name},
+                    ))
+                elif mod == "random":
+                    out.append(Finding(
+                        "jaxlint", "jax-impure-call", "error",
+                        f"{rel}:{node.lineno}",
+                        f"{fn_name}: stdlib random.{func.attr}() under jit "
+                        "compiles one sample forever; use jax.random",
+                        {"fn": fn_name},
+                    ))
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "random"
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id in ("np", "numpy")
+            ):
+                out.append(Finding(
+                    "jaxlint", "jax-impure-call", "error",
+                    f"{rel}:{node.lineno}",
+                    f"{fn_name}: np.random.{func.attr}() under jit compiles "
+                    "one sample forever; use jax.random",
+                    {"fn": fn_name},
+                ))
+
+    params = site.params()
+    static = site.static_params()
+    defaults = list(site.fn.args.defaults)
+    defaulted = params[len(params) - len(defaults):] if defaults else []
+    for param, default in zip(defaulted, defaults):
+        if param.arg in static and isinstance(
+            default, (ast.List, ast.Dict, ast.Set)
+        ):
+            out.append(Finding(
+                "jaxlint", "jax-unhashable-static", "error",
+                f"{rel}:{default.lineno}",
+                f"{fn_name}: static arg {param.arg!r} defaults to an "
+                "unhashable literal — jit static args must hash",
+                {"fn": fn_name, "param": param.arg},
+            ))
+
+    pool_params = sorted(
+        p.arg for p in params if p.arg in _POOL_PARAMS and p.arg in traced
+    )
+    if pool_params and not site.donates:
+        out.append(Finding(
+            "jaxlint", "jax-missing-donate", "error",
+            f"{rel}:{site.lineno}",
+            f"{fn_name}: jit carries KV pool arg(s) "
+            f"{', '.join(pool_params)} without donate_argnums — the stale "
+            "pool stays alive across the call, doubling page HBM",
+            {"fn": fn_name, "params": pool_params},
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+#: The directories `dora-tpu lint --self` sweeps (jit lives here).
+SELF_DIRS = ("models", "ops", "parallel", "tpu")
+
+
+def lint_file(path: str | Path, rel: str | None = None) -> list[Finding]:
+    path = Path(path)
+    rel = rel or str(path)
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [Finding(
+            "jaxlint", "jax-parse", "error", f"{rel}:{e.lineno}", str(e)
+        )]
+    out: list[Finding] = []
+    for site in _collect_sites(tree):
+        out.extend(_lint_site(site, rel))
+    return out
+
+
+def lint_paths(paths: list[str | Path]) -> list[Finding]:
+    out: list[Finding] = []
+    for raw in paths:
+        p = Path(raw)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            out.extend(lint_file(f, str(f)))
+    return out
+
+
+def lint_self(package_root: str | Path) -> list[Finding]:
+    """Sweep the repo's own jit-bearing trees (``dora-tpu lint --self``)."""
+    root = Path(package_root)
+    out: list[Finding] = []
+    for d in SELF_DIRS:
+        sub = root / d
+        if sub.exists():
+            for f in sorted(sub.rglob("*.py")):
+                out.extend(lint_file(f, str(f.relative_to(root.parent))))
+    return out

@@ -1,0 +1,582 @@
+"""Lock-order race detector (``DORA_LOCKCHECK=1``).
+
+Every lock in the Python control/data plane is created through
+:func:`tracked_lock` / :func:`tracked_rlock`. With ``DORA_LOCKCHECK``
+unset the factory returns a plain ``threading.Lock`` / ``RLock`` — the
+production hot path pays nothing beyond the one-time factory call, the
+flight-recorder discipline. With it set, the factory returns a wrapper
+that maintains a per-thread held-lock list and feeds a process-wide
+lock-ORDER graph: an edge ``A -> B`` means some thread acquired B while
+holding A. The detector reports:
+
+* **order-graph cycles** — two locks ever taken in both orders by any
+  threads is a potential ABBA deadlock, even if the run never actually
+  deadlocked (the classic happened-before shadow of lockdep);
+* **locks held across blocking calls** — ``queue`` waits, socket
+  send/recv, ``time.sleep``, ``Event.wait``, shmem channel send/recv and
+  ``jax.block_until_ready`` are probed; holding a lock across any of
+  them serializes unrelated threads behind I/O. Locks that exist to
+  serialize a blocking resource (a shared socket, a request-reply
+  channel) opt out with ``allow_blocking=True`` — the suppression is at
+  the lock, visible at its construction site;
+* **long holds** — a hold beyond ``DORA_LOCKCHECK_HOLD_MS`` (default
+  100) is recorded with its stack.
+
+Findings land as flight-recorder instants (``lock_blocking``,
+``lock_long_hold``) on the trace timeline and in an end-of-process
+report; tier-1 runs with the detector on and fails on any unexplained
+cycle (tests/conftest.py). Per-edge stacks are captured only on FIRST
+observation, so the steady state allocates a tuple and a set lookup per
+nested acquire and nothing per flat acquire.
+
+Known limits (KNOWN_ISSUES round 17): the detector sees *executed*
+orders only — an untaken branch hides its edge; ``asyncio.Lock``
+(daemon/inter_daemon.py) is not tracked — coroutines interleave on one
+thread and ABBA needs the wait graph, not the held set; blocking probes
+see module-attribute calls only (``from time import sleep`` escapes).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+from dora_tpu.analysis import Finding
+from dora_tpu.telemetry import FLIGHT
+
+
+class LockCheckState:
+    """Process-wide detector switch (``DORA_LOCKCHECK=1``); mirrors
+    :class:`dora_tpu.telemetry.TracingState` — one attribute check to
+    know the detector is off."""
+
+    __slots__ = ("active",)
+
+    def __init__(self, active: bool = False):
+        self.active = active
+
+    def configure_from_env(self) -> None:
+        self.active = os.environ.get("DORA_LOCKCHECK", "") not in ("", "0")
+
+
+LOCKCHECK = LockCheckState(os.environ.get("DORA_LOCKCHECK", "") not in ("", "0"))
+
+#: Hold-duration outlier threshold (ns), env-tunable for tests.
+_HOLD_NS = int(
+    float(os.environ.get("DORA_LOCKCHECK_HOLD_MS", "100") or "100") * 1e6
+)
+
+_STACK_LIMIT = 12
+
+# ---------------------------------------------------------------------------
+# global detector state (the meta lock is a RAW threading.Lock on purpose:
+# the detector must not observe itself)
+# ---------------------------------------------------------------------------
+
+_meta = threading.Lock()
+#: (held_name, acquired_name) -> {"count": int, "stack": str}
+_edges: dict[tuple[str, str], dict] = {}
+#: fast lock-free dedup shadow of _edges' keys (benign race: a miss only
+#: costs one extra _meta acquisition)
+_edge_seen: set[tuple[str, str]] = set()
+#: (kind, lock_name, call) -> {"count": int, "stack": str, ...}
+_events: dict[tuple[str, str, str], dict] = {}
+_event_seen: set[tuple[str, str, str]] = set()
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _stack() -> str:
+    # Skip the detector's own frames (the last two).
+    return "".join(traceback.format_stack(limit=_STACK_LIMIT)[:-2])
+
+
+def _note_edges(held: list, name: str) -> None:
+    for rec in held:
+        held_name = rec[0]
+        if held_name == name:
+            continue
+        key = (held_name, name)
+        if key in _edge_seen:
+            with _meta:
+                entry = _edges.get(key)
+                if entry is not None:
+                    entry["count"] += 1
+                    continue
+        stack = _stack()
+        with _meta:
+            entry = _edges.setdefault(key, {"count": 0, "stack": stack})
+            entry["count"] += 1
+            _edge_seen.add(key)
+
+
+def _note_event(kind: str, lock_name: str, call: str, dur_ns: int = 0) -> None:
+    FLIGHT.record(f"lock_{kind}", lock_name, call or None, dur_ns or None)
+    key = (kind, lock_name, call)
+    if key in _event_seen:
+        with _meta:
+            entry = _events.get(key)
+            if entry is not None:
+                entry["count"] += 1
+                if dur_ns > entry["max_ns"]:
+                    entry["max_ns"] = dur_ns
+                return
+    stack = _stack()
+    with _meta:
+        entry = _events.setdefault(
+            key, {"count": 0, "stack": stack, "max_ns": 0}
+        )
+        entry["count"] += 1
+        if dur_ns > entry["max_ns"]:
+            entry["max_ns"] = dur_ns
+        _event_seen.add(key)
+
+
+# ---------------------------------------------------------------------------
+# tracked lock wrappers
+# ---------------------------------------------------------------------------
+
+
+class TrackedLock:
+    """``threading.Lock`` wrapper feeding the order graph. Only handed
+    out when the detector is active — off-path code holds a plain lock.
+
+    Held-list entries are mutable ``[name, allow_blocking, t0_ns, depth,
+    lock_id]`` records; matching is by instance identity (two instances
+    from one construction site can be held at once) while the order
+    graph keys on the site ``name`` — order analysis is per-site, like
+    lockdep classes."""
+
+    __slots__ = ("name", "allow_blocking", "_inner")
+
+    def __init__(self, name: str, allow_blocking: bool = False):
+        self.name = name
+        self.allow_blocking = allow_blocking
+        self._inner = self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def _entry(self, held: list):
+        me = id(self)
+        for rec in reversed(held):
+            if rec[4] == me:
+                return rec
+        return None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        _note_edges(held, self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            held.append(
+                [self.name, self.allow_blocking, time.monotonic_ns(), 1,
+                 id(self)]
+            )
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        rec = self._entry(held)
+        if rec is not None:
+            held.remove(rec)
+            dur = time.monotonic_ns() - rec[2]
+            if dur > _HOLD_NS and not self.allow_blocking:
+                _note_event("long_hold", self.name, "", dur)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{type(self).__name__} {self.name!r} {self._inner!r}>"
+
+
+class TrackedRLock(TrackedLock):
+    """Reentrant variant: only the outermost acquire adds a held entry
+    and order edges; inner levels bump the entry's depth, so recursion
+    neither self-edges nor drops tracking early."""
+
+    __slots__ = ()
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        rec = self._entry(held)
+        if rec is not None:
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                rec[3] += 1
+            return got
+        _note_edges(held, self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            held.append(
+                [self.name, self.allow_blocking, time.monotonic_ns(), 1,
+                 id(self)]
+            )
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        rec = self._entry(held)
+        if rec is not None:
+            rec[3] -= 1
+            if rec[3] == 0:
+                held.remove(rec)
+                dur = time.monotonic_ns() - rec[2]
+                if dur > _HOLD_NS and not self.allow_blocking:
+                    _note_event("long_hold", self.name, "", dur)
+        self._inner.release()
+
+    def locked(self) -> bool:  # pragma: no cover - parity with RLock
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+
+def tracked_lock(name: str, *, allow_blocking: bool = False):
+    """A lock feeding the order graph under ``DORA_LOCKCHECK=1``; a plain
+    ``threading.Lock`` otherwise. ``name`` identifies the construction
+    site (all instances from one site share a graph node — order analysis
+    is per-site, like lockdep classes). ``allow_blocking=True`` suppresses
+    held-across-blocking-call and long-hold findings for locks whose JOB
+    is to serialize a blocking resource."""
+    if not LOCKCHECK.active:
+        return threading.Lock()
+    install_probes()
+    return TrackedLock(name, allow_blocking)
+
+
+def tracked_rlock(name: str, *, allow_blocking: bool = False):
+    """Reentrant counterpart of :func:`tracked_lock`."""
+    if not LOCKCHECK.active:
+        return threading.RLock()
+    install_probes()
+    return TrackedRLock(name, allow_blocking)
+
+
+# ---------------------------------------------------------------------------
+# blocking-call probes
+# ---------------------------------------------------------------------------
+
+_probed: set[str] = set()
+
+
+def _blocking_hit(call: str) -> None:
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for rec in held:
+        if not rec[1]:
+            _note_event("blocking", rec[0], call)
+
+
+def install_probes() -> None:
+    """Patch the blocking primitives the data plane actually parks on so
+    a held tracked lock across any of them becomes a finding. Idempotent
+    per target; called from the factories so targets that import late
+    (native, jax) get picked up by the next lock construction."""
+    if "queue" not in _probed:
+        _probed.add("queue")
+        import queue as _queue
+
+        def _probe_get(orig):
+            def get(self, block=True, timeout=None):
+                if block:
+                    _blocking_hit("queue.Queue.get")
+                return orig(self, block, timeout)
+
+            return get
+
+        def _probe_put(orig):
+            def put(self, item, block=True, timeout=None):
+                if block:
+                    _blocking_hit("queue.Queue.put")
+                return orig(self, item, block, timeout)
+
+            return put
+
+        _queue.Queue.get = _probe_get(_queue.Queue.get)
+        _queue.Queue.put = _probe_put(_queue.Queue.put)
+
+    if "socket" not in _probed:
+        _probed.add("socket")
+        import socket as _socket
+
+        def _probe_sock(meth_name):
+            orig = getattr(_socket.socket, meth_name)
+
+            def probe(self, *args, **kwargs):
+                if self.gettimeout() != 0:
+                    _blocking_hit(f"socket.{meth_name}")
+                return orig(self, *args, **kwargs)
+
+            return probe
+
+        for meth in ("send", "sendall", "recv", "accept", "connect"):
+            setattr(_socket.socket, meth, _probe_sock(meth))
+
+    if "time" not in _probed:
+        _probed.add("time")
+        _orig_sleep = time.sleep
+
+        def sleep(secs):
+            if secs > 0.001:
+                _blocking_hit("time.sleep")
+            return _orig_sleep(secs)
+
+        time.sleep = sleep
+
+    if "event" not in _probed:
+        _probed.add("event")
+        _orig_wait = threading.Event.wait
+
+        def wait(self, timeout=None):
+            if timeout is None or timeout > 0.001:
+                _blocking_hit("threading.Event.wait")
+            return _orig_wait(self, timeout)
+
+        threading.Event.wait = wait
+
+    if "native" not in _probed and "dora_tpu.native" in sys.modules:
+        native = sys.modules["dora_tpu.native"]
+        channel = getattr(native, "ShmemChannel", None)
+        if channel is not None:
+            _probed.add("native")
+
+            def _probe_chan(meth_name):
+                orig = getattr(channel, meth_name)
+
+                def probe(self, *args, **kwargs):
+                    _blocking_hit(f"ShmemChannel.{meth_name}")
+                    return orig(self, *args, **kwargs)
+
+                return probe
+
+            for meth in ("send", "recv"):
+                setattr(channel, meth, _probe_chan(meth))
+
+    if "jax" not in _probed and "jax" in sys.modules:
+        jax = sys.modules["jax"]
+        orig_burt = getattr(jax, "block_until_ready", None)
+        if orig_burt is not None:
+            _probed.add("jax")
+
+            def block_until_ready(x):
+                _blocking_hit("jax.block_until_ready")
+                return orig_burt(x)
+
+            jax.block_until_ready = block_until_ready
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+
+def _allowed_edges() -> set[tuple[str, str]]:
+    """``DORA_LOCKCHECK_ALLOW="a>b,c>d"`` removes known-benign edges
+    before cycle detection (the suppression story for false ABBAs from
+    per-site granularity, README "Static analysis")."""
+    out: set[tuple[str, str]] = set()
+    for part in os.environ.get("DORA_LOCKCHECK_ALLOW", "").split(","):
+        a, sep, b = part.strip().partition(">")
+        if sep and a and b:
+            out.add((a, b))
+    return out
+
+
+def order_graph() -> dict[tuple[str, str], dict]:
+    with _meta:
+        return {k: dict(v) for k, v in _edges.items()}
+
+
+def order_cycles() -> list[list[str]]:
+    """Elementary cycles in the lock-order graph (each reported once,
+    rotated to start at its smallest name). A cycle means the involved
+    locks were taken in incompatible orders by live code paths."""
+    allow = _allowed_edges()
+    with _meta:
+        adj: dict[str, set[str]] = {}
+        for a, b in _edges:
+            if (a, b) in allow:
+                continue
+            adj.setdefault(a, set()).add(b)
+
+    cycles: set[tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: list[str], on_path: set[str]) -> None:
+        for nxt in adj.get(node, ()):
+            if nxt == start:
+                cycles.add(tuple(path))
+            elif nxt not in on_path and nxt > start:
+                # Only walk names > start: every cycle is found from its
+                # smallest member exactly once.
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return [list(c) for c in sorted(cycles)]
+
+
+def findings() -> list[Finding]:
+    """Everything the detector saw, as lint findings: cycles are errors,
+    blocking/long-hold events are warnings (fix or opt the lock out)."""
+    out: list[Finding] = []
+    with _meta:
+        edges = {k: dict(v) for k, v in _edges.items()}
+        events = {k: dict(v) for k, v in _events.items()}
+    for cycle in order_cycles():
+        stacks = {
+            f"{a}->{b}": edges[(a, b)]["stack"]
+            for a, b in zip(cycle, cycle[1:] + cycle[:1])
+            if (a, b) in edges
+        }
+        out.append(Finding(
+            "lockcheck", "lock-cycle", "error", " -> ".join(cycle),
+            "locks acquired in incompatible orders (potential ABBA deadlock)",
+            {"cycle": cycle, "stacks": stacks},
+        ))
+    for (kind, lock_name, call), entry in sorted(events.items()):
+        if kind == "blocking":
+            out.append(Finding(
+                "lockcheck", "lock-blocking", "warning", lock_name,
+                f"held across blocking call {call} ({entry['count']}x)",
+                {"call": call, "count": entry["count"],
+                 "stack": entry["stack"]},
+            ))
+        else:
+            out.append(Finding(
+                "lockcheck", "lock-long-hold", "warning", lock_name,
+                f"held {entry['max_ns'] / 1e6:.1f} ms "
+                f"(threshold {_HOLD_NS / 1e6:.0f} ms, {entry['count']}x)",
+                {"max_ns": entry["max_ns"], "count": entry["count"],
+                 "stack": entry["stack"]},
+            ))
+    return out
+
+
+def forget(prefix: str) -> None:
+    """Drop edges/events whose lock names start with ``prefix`` — test
+    fixtures seed violations under a ``test.`` prefix and clean up so the
+    session-end zero-cycle gate only sees real code."""
+    with _meta:
+        for key in [k for k in _edges if k[0].startswith(prefix)
+                    or k[1].startswith(prefix)]:
+            del _edges[key]
+            _edge_seen.discard(key)
+        for key in [k for k in _events if k[1].startswith(prefix)]:
+            del _events[key]
+            _event_seen.discard(key)
+
+
+def reset() -> None:
+    with _meta:
+        _edges.clear()
+        _edge_seen.clear()
+        _events.clear()
+        _event_seen.clear()
+
+
+def report(file=None) -> None:
+    """End-of-process report (installed atexit when the detector is on;
+    silent when nothing was found)."""
+    found = findings()
+    if not found:
+        return
+    file = file or sys.stderr
+    print(f"--- lockcheck report ({len(found)} findings)", file=file)
+    for f in found:
+        print(f"  {f.render()}", file=file)
+        stack = f.detail.get("stack")
+        for key, s in (f.detail.get("stacks") or {}).items():
+            print(f"    edge {key}:", file=file)
+            print("      " + "      ".join(s.splitlines(True)), file=file)
+        if stack:
+            print("    " + "    ".join(stack.splitlines(True)), file=file)
+    file.flush()
+
+
+if LOCKCHECK.active and os.environ.get(
+    "DORA_LOCKCHECK_REPORT", "1"
+) not in ("", "0"):
+    import atexit
+
+    atexit.register(report)
+
+
+# ---------------------------------------------------------------------------
+# static wiring lint (part of `dora-tpu lint --self`)
+# ---------------------------------------------------------------------------
+
+#: Directories whose locks must go through the factories (the tentpole's
+#: wiring contract); clock.py and native.py ride along as shared hot paths.
+WIRED_DIRS = ("daemon", "node", "transport", "nodehub", "tpu", "ros2")
+WIRED_FILES = ("clock.py", "native.py")
+
+
+def lint_lock_wiring(package_root: str) -> list[Finding]:
+    """Flag raw ``threading.Lock()``/``RLock()`` constructions inside the
+    wired directories — every lock there must come from
+    :func:`tracked_lock` so the detector's coverage cannot silently rot."""
+    import ast
+    from pathlib import Path
+
+    root = Path(package_root)
+    out: list[Finding] = []
+    paths: list[Path] = []
+    for d in WIRED_DIRS:
+        paths.extend(sorted((root / d).rglob("*.py")))
+    paths.extend(root / f for f in WIRED_FILES)
+    for path in paths:
+        if not path.exists():
+            continue
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:  # pragma: no cover - repo parses
+            out.append(Finding(
+                "lockcheck", "lock-wiring-parse", "error",
+                f"{path}:{e.lineno}", str(e)))
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("Lock", "RLock")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "threading"
+            ):
+                out.append(Finding(
+                    "lockcheck", "lock-untracked", "error",
+                    f"{path.relative_to(root.parent)}:{node.lineno}",
+                    f"raw threading.{fn.attr}() in a wired directory — "
+                    "use dora_tpu.analysis.lockcheck.tracked_lock()",
+                ))
+    return out

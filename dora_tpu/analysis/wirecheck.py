@@ -1,0 +1,85 @@
+"""Serde/wire coverage lint.
+
+Every ``@message`` dataclass must (a) be in ``_REGISTRY`` with a
+compiled codec in ``_PACK``/``_UNPACK`` — registration compiles these,
+so a gap means the decorator half-ran — and (b) be constructible by the
+golden test's ``_sample`` builder, so tests/test_serde_golden.py really
+exercises it. A field annotation ``_sample`` cannot build (a new
+container type, an unannotated Any-like) silently drops that class from
+golden coverage; this lint turns that into an error.
+
+Codes: ``serde-missing-codec``, ``serde-golden-uncoverable``,
+``serde-registry-empty``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import pkgutil
+from pathlib import Path
+
+from dora_tpu.analysis import Finding
+
+
+def _load_registry():
+    import dora_tpu.message as message_pkg
+    from dora_tpu.message import serde
+
+    for mod in pkgutil.iter_modules(message_pkg.__path__):
+        importlib.import_module(f"dora_tpu.message.{mod.name}")
+    return serde
+
+
+def _load_sample_builder(repo_root: Path):
+    """Import the golden test module for its ``_sample`` builder, so the
+    lint checks exactly what the tests exercise."""
+    test_path = repo_root / "tests" / "test_serde_golden.py"
+    if not test_path.exists():
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "_dora_serde_golden_for_lint", test_path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return getattr(mod, "_sample", None)
+
+
+def lint(repo_root: str | Path = ".") -> list[Finding]:
+    out: list[Finding] = []
+    serde = _load_registry()
+    registry = serde._REGISTRY
+    if len(registry) < 50:
+        out.append(Finding(
+            "wirecheck", "serde-registry-empty", "error", "message/serde.py",
+            f"only {len(registry)} registered message classes — the "
+            "registry import sweep collapsed",
+        ))
+    for name in sorted(registry):
+        cls = registry[name]
+        if cls not in serde._PACK or name not in serde._UNPACK:
+            out.append(Finding(
+                "wirecheck", "serde-missing-codec", "error", name,
+                "registered message class has no compiled pack/unpack "
+                "codec — wire encode would fall back or fail",
+            ))
+
+    sample = _load_sample_builder(Path(repo_root))
+    if sample is None:
+        out.append(Finding(
+            "wirecheck", "serde-golden-uncoverable", "error",
+            "tests/test_serde_golden.py",
+            "golden test module (or its _sample builder) not found — "
+            "no golden coverage for any message class",
+        ))
+        return out
+    for name in sorted(registry):
+        try:
+            obj = sample(registry[name])
+            serde.decode(serde.encode(obj))
+        except Exception as e:  # noqa: BLE001 - any failure is the finding
+            out.append(Finding(
+                "wirecheck", "serde-golden-uncoverable", "error", name,
+                f"golden _sample cannot build/round-trip this class: {e}",
+            ))
+    return out

@@ -77,10 +77,59 @@ def _control(args):
 
 
 def cmd_check(args) -> int:
+    import json
+
+    from dora_tpu.analysis import errors as _errors
+    from dora_tpu.analysis.graphcheck import check_descriptor
+
     descriptor = _read_descriptor(args.dataflow)
-    descriptor.check(Path(args.dataflow).parent)
-    print(f"{args.dataflow}: OK ({len(descriptor.nodes)} nodes)")
-    return 0
+    findings = check_descriptor(descriptor, Path(args.dataflow).parent)
+    if getattr(args, "json", False):
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if not _errors(findings):
+            print(f"{args.dataflow}: OK ({len(descriptor.nodes)} nodes)")
+    return 1 if _errors(findings) else 0
+
+
+def cmd_lint(args) -> int:
+    """Run the static-analysis passes (``dora-tpu lint``).
+
+    ``--self`` lints this installation's own package tree: jaxlint over
+    the jit-heavy dirs, the env registry, serde/wire coverage, and the
+    raw-``threading.Lock`` wiring check. With explicit paths, only
+    jaxlint runs over those files/dirs.
+    """
+    import json
+
+    from dora_tpu.analysis import errors as _errors
+    from dora_tpu.analysis import jaxlint
+
+    findings = []
+    if args.paths:
+        findings += jaxlint.lint_paths([Path(p) for p in args.paths])
+    if args.self or not args.paths:
+        import dora_tpu
+        from dora_tpu.analysis import envreg, wirecheck
+        from dora_tpu.analysis.lockcheck import lint_lock_wiring
+
+        pkg_root = Path(dora_tpu.__file__).parent
+        repo_root = pkg_root.parent
+        findings += jaxlint.lint_self(pkg_root)
+        findings += envreg.lint(pkg_root, repo_root / "README.md")
+        findings += wirecheck.lint(repo_root)
+        findings += lint_lock_wiring(pkg_root)
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        errs = _errors(findings)
+        warns = len(findings) - len(errs)
+        print(f"lint: {len(errs)} error(s), {warns} warning(s)")
+    return 1 if _errors(findings) else 0
 
 
 def cmd_graph(args) -> int:
@@ -625,7 +674,27 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("check", help="validate a dataflow YAML")
     p.add_argument("dataflow")
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
     p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser(
+        "lint",
+        help="static analysis: jax recompile hazards, env registry, "
+        "serde coverage, lock wiring",
+    )
+    p.add_argument(
+        "paths", nargs="*", help="files/dirs for jaxlint (default: --self)"
+    )
+    p.add_argument(
+        "--self", action="store_true",
+        help="lint this installation's own package tree (all passes)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("graph", help="visualize a dataflow as mermaid/HTML")
     p.add_argument("dataflow")

@@ -14,6 +14,8 @@ physical nanoseconds in the high 64 bits, a logical counter in the low 16.
 from __future__ import annotations
 
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 import time
 import uuid
 from typing import NamedTuple
@@ -59,7 +61,7 @@ class HLC:
 
     def __init__(self, id: str | None = None):
         self.id = id or uuid.uuid4().hex
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("clock.hlc")
         self._last = time.time_ns() << _LOGICAL_BITS
 
     def new_timestamp(self) -> Timestamp:

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 from typing import Awaitable, Callable
 
 from dora_tpu.native import Disconnected, ShmemChannel
@@ -82,7 +84,7 @@ class ShmemConnection(NodeConnection):
     def __init__(self, channel: ShmemChannel):
         self.channel = channel
         self._closing = False
-        self._close_lock = threading.Lock()
+        self._close_lock = tracked_lock("daemon.connection.close")
         self._channel_closed = False
         self._loop = asyncio.get_running_loop()
         self._incoming: asyncio.Queue[bytes | None] = asyncio.Queue()

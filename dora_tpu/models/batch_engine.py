@@ -1523,7 +1523,11 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         return window_step
 
     chunk_jit = jax.jit(
-        lambda ids, pools, position, bt: (rule(ids), pools)
+        lambda ids, pools, position, bt: (rule(ids), pools),
+        # Same donation contract as the real chunk fns (hf/qwen2.py):
+        # the engine replaces its pools reference with the return value,
+        # so the stale buffer must not stay alive.
+        donate_argnums=(1,),
     )
     if chunk_sleep_s:
         # Emulate per-chunk device cost (the prefix-cache A/B bench

@@ -10,13 +10,17 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
 _SRC = _HERE.parent / "native" / "shmem.cpp"
 _LIB = _HERE / "_native.so"
 
-_lock = threading.Lock()
+# Serializes the one-time g++ build/load; compile time under the
+# lock is expected.
+_lock = tracked_lock("native.build", allow_blocking=True)
 _lib: ctypes.CDLL | None = None
 
 

@@ -20,6 +20,8 @@ from __future__ import annotations
 import os
 import socket
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 import time
 import uuid
 from typing import Any
@@ -130,7 +132,7 @@ class Node:
         )
 
         # Sender-side shmem region bookkeeping.
-        self._regions_lock = threading.Lock()
+        self._regions_lock = tracked_lock("node.regions")
         self._regions_in_use: dict[str, ShmemRegion] = {}  # token -> region
         self._regions_free: list[ShmemRegion] = []
         self._finished_unreported: list[str] = []

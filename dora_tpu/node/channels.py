@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import socket
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 from typing import Any
 
 from dora_tpu import PROTOCOL_VERSION
@@ -93,7 +95,10 @@ class DaemonChannel:
     def __init__(self, transport, clock):
         self._transport = transport
         self._clock = clock
-        self._lock = threading.Lock()
+        # Held across transport send AND recv: request() IS the
+        # request-reply serialization point for this channel, so
+        # blocking under it is the contract, not a hazard.
+        self._lock = tracked_lock("node.channels.daemon", allow_blocking=True)
         self._pending: list[bytes] = []
         self._pending_bytes = 0
         self.closed = False

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 import weakref
 from typing import Any
 
@@ -97,12 +99,12 @@ class EventStream:
         #: events cannot be overtaken by the daemon's AllInputsClosed)
         self.pre_end = None
         #: region cache is shared with p2p edge threads
-        self._regions_guard = threading.Lock()
+        self._regions_guard = tracked_lock("node.events.regions")
         if max_queue is None:
             max_queue = self.DEFAULT_MAX_QUEUE
         self._queue: queue_mod.Queue = queue_mod.Queue(max_queue)
         self._pending_acks: list[str] = []
-        self._acks_lock = threading.Lock()
+        self._acks_lock = tracked_lock("node.events.acks")
         self._closed = threading.Event()
         #: set by the pump once no further real events can arrive (the
         #: end-of-stream sentinel is queued or being queued)

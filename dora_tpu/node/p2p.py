@@ -44,6 +44,8 @@ import collections
 import logging
 import queue as queue_mod
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 import time
 import uuid
 from typing import Any
@@ -98,7 +100,7 @@ class _EdgeServer:
         #: flight inside the channel itself.
         self.last_idle = 0.0
         self._acks: list[str] = []
-        self._acks_lock = threading.Lock()
+        self._acks_lock = tracked_lock("node.p2p.edge_acks")
         self.thread = threading.Thread(
             target=self._run, name=f"dora-p2p-{sender}", daemon=True
         )
@@ -217,7 +219,7 @@ class P2PEndpoint:
         #: output_id -> d2n.P2POutput
         self.outbound: dict[str, Any] = {}
         self._out_channels: dict[str, ShmemChannel] = {}
-        self._out_lock = threading.Lock()
+        self._out_lock = tracked_lock("node.p2p.out")
         self._readers: list[threading.Thread] = []
         # One channel per SENDER (grouping that sender's inputs): the
         # descriptor knows each input's source; the announce format
@@ -338,6 +340,12 @@ class P2PEndpoint:
         daemon SendMessage discipline), so the sender never waits out
         the receiver's thread wake-ups. Acks flow back asynchronously
         on the reverse direction, drained by a per-channel reader."""
+        # _out_lock guards only the channel-table bookkeeping; the send
+        # happens OUTSIDE it. Holding it across channel.send() made the
+        # ack-flush path serialize behind a receiver stuck in its flow-
+        # control window (lockcheck: held-across-blocking). Callers are
+        # single-sender per the node.send_output contract, so the bare
+        # send needs no lock of its own.
         with self._out_lock:
             channel = self._out_channels.get(edge.channel)
             if channel is None:
@@ -351,7 +359,7 @@ class P2PEndpoint:
                 )
                 reader.start()
                 self._readers.append(reader)
-            channel.send(frame)
+        channel.send(frame)
 
     def _ack_reader(self, channel: ShmemChannel) -> None:
         while not self.closed.is_set():

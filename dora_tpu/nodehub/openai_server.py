@@ -41,6 +41,8 @@ import json
 import os
 import queue
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -63,8 +65,11 @@ def main() -> None:
     responses: queue.Queue = queue.Queue()
     #: concurrent mode: request_id -> its private chunk queue
     routed: dict[str, queue.Queue] = {}
-    routed_lock = threading.Lock()
-    send_lock = threading.Lock()
+    routed_lock = tracked_lock("nodehub.openai.routed")
+    # Serial mode holds this across send_output + the reply queue
+    # get: whole-request serialization is the documented contract
+    # (node.send_output is not thread-safe).
+    send_lock = tracked_lock("nodehub.openai.send", allow_blocking=True)
     served = [0]
 
     class Handler(BaseHTTPRequestHandler):

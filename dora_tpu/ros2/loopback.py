@@ -25,6 +25,8 @@ from __future__ import annotations
 import queue
 import sys
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 import types
 from collections import defaultdict
 
@@ -32,7 +34,7 @@ from dora_tpu.ros2 import find_interface
 
 #: topic -> list of (msg_cls, callback, executor)
 _BUS: dict[str, list] = defaultdict(list)
-_BUS_LOCK = threading.Lock()
+_BUS_LOCK = tracked_lock("ros2.loopback.bus")
 
 
 _PRIMITIVE_DEFAULTS = {

@@ -47,6 +47,8 @@ import random
 import socket
 import struct
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_rlock
 import time
 from dataclasses import dataclass, field
 
@@ -215,7 +217,7 @@ class RtpsParticipant:
         self._remote_readers: dict[bytes, _RemoteEndpoint] = {}
         self._peers: dict[bytes, _Peer] = {}
         self._next_entity = 1
-        self._lock = threading.RLock()
+        self._lock = tracked_rlock("ros2.rtps")
         self._closed = threading.Event()
         #: advertised SPDP lease (peers drop us this long after our last
         #: announcement); tests shrink it to exercise expiry.

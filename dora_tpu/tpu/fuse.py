@@ -17,6 +17,8 @@ which is the reference's ``queue_size: 1`` idiom.
 
 from __future__ import annotations
 
+from dora_tpu.analysis.lockcheck import tracked_lock
+
 import logging
 from dataclasses import dataclass, field
 from typing import Any
@@ -337,7 +339,7 @@ class FusedExecutor:
         # group submission must not race it.
         import threading
 
-        self._stage_lock = threading.Lock()
+        self._stage_lock = tracked_lock("tpu.fuse.stage")
         if self.pipeline_depth > 0:
             from concurrent.futures import ThreadPoolExecutor
 

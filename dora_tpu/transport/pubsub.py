@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import socket
 import threading
+
+from dora_tpu.analysis.lockcheck import tracked_lock
 from typing import Callable
 
 from dora_tpu.transport.framing import (
@@ -61,7 +63,7 @@ class Broker:
         self._server.listen(64)
         self.port = self._server.getsockname()[1]
         self._subs: dict[str, list[socket.socket]] = {}
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("transport.broker")
         self._closing = False
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
@@ -120,7 +122,9 @@ class TcpPubSub(CommunicationLayer):
         host, _, port = broker_addr.rpartition(":")
         self._addr = (host, int(port))
         self._pub_sock: socket.socket | None = None
-        self._pub_lock = threading.Lock()
+        # One shared pub socket: holding across connect/send IS the
+        # serialization that keeps frames un-interleaved.
+        self._pub_lock = tracked_lock("transport.pubsub.pub", allow_blocking=True)
         self._subscriptions: list[_TcpSubscription] = []
 
     def publisher(self, topic: str) -> Publisher:

@@ -43,6 +43,15 @@ import uuid as _uuid
 _SESSION_MARK = f"{os.getpid()}-{_uuid.uuid4().hex[:12]}"
 os.environ["DORA_TEST_SESSION"] = _SESSION_MARK
 
+# Tier-1 runs with the lock-order race detector armed: every tracked
+# lock records acquisition order, and the sessionfinish hook below fails
+# the run on any order-graph cycle (potential ABBA deadlock) observed
+# anywhere in the suite. Opt out per-run with DORA_LOCKCHECK=0.
+# Quiet by default: the cycle gate asserts; the full report stays off
+# unless explicitly requested.
+os.environ.setdefault("DORA_LOCKCHECK", "1")
+os.environ.setdefault("DORA_LOCKCHECK_REPORT", "0")
+
 
 def pytest_sessionfinish(session, exitstatus):
     """Teardown reaper: no orphaned node processes survive a run.
@@ -73,3 +82,33 @@ def pytest_sessionfinish(session, exitstatus):
                 print(f"\n[reaper] killed orphaned node process {pid}")
             except OSError:
                 pass
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture(scope="session", autouse=True)
+def _lockcheck_cycle_gate():
+    """Fail the session on any lock-order cycle observed while it ran.
+
+    Cycles (potential ABBA deadlocks) are hard errors; held-across-
+    blocking and long-hold findings stay advisory — they are reported by
+    `dora-tpu`'s atexit report when DORA_LOCKCHECK_REPORT=1 but do not
+    gate the suite. Tests that seed deliberate violations use
+    "test."-prefixed lock names and lockcheck.forget("test.") so only
+    real product locks reach this gate.
+    """
+    yield
+    from dora_tpu.analysis import lockcheck
+
+    if not lockcheck.LOCKCHECK.active:
+        return
+    cycles = lockcheck.order_cycles()
+    if cycles:
+        import sys as _sys
+
+        lockcheck.report(_sys.stderr)
+        raise AssertionError(
+            f"lockcheck: {len(cycles)} lock-order cycle(s) observed "
+            f"during the test session: {cycles}"
+        )

@@ -1,0 +1,608 @@
+"""Tests for the static/dynamic analysis plane (dora_tpu.analysis).
+
+Seeded-violation positives prove each detector actually fires; negatives
+prove the clean shapes stay clean. Lockcheck fixtures use "test."-
+prefixed lock names and forget("test.") so the session-end zero-cycle
+gate in conftest only ever sees real product locks.
+"""
+
+from __future__ import annotations
+
+import queue
+import textwrap
+import threading
+
+import pytest
+import yaml
+
+from dora_tpu.analysis import Finding, errors
+from dora_tpu.analysis import lockcheck as lc
+from dora_tpu.analysis import envreg, jaxlint, wirecheck
+from dora_tpu.analysis.graphcheck import check_descriptor
+from dora_tpu.core.descriptor import Descriptor
+
+
+def parse(y: str) -> Descriptor:
+    return Descriptor.parse(yaml.safe_load(textwrap.dedent(y)))
+
+
+def codes(findings: list[Finding]) -> set[str]:
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# lockcheck: seeded violations + negatives
+# ---------------------------------------------------------------------------
+
+needs_lockcheck = pytest.mark.skipif(
+    not lc.LOCKCHECK.active, reason="DORA_LOCKCHECK is off"
+)
+
+
+def _test_cycles() -> list[list[str]]:
+    return [c for c in lc.order_cycles()
+            if any(n.startswith("test.") for n in c)]
+
+
+@needs_lockcheck
+class TestLockcheck:
+    def test_abba_cycle_detected(self):
+        a = lc.tracked_lock("test.abba.a")
+        b = lc.tracked_lock("test.abba.b")
+        try:
+            done = threading.Event()
+
+            def other():
+                with a:
+                    with b:
+                        pass
+                done.set()
+
+            t = threading.Thread(target=other)
+            t.start()
+            t.join(5)
+            assert done.is_set()
+            # Opposite order on this thread: sequenced after the worker
+            # finished, so no real deadlock — only the order record.
+            with b:
+                with a:
+                    pass
+            cycles = _test_cycles()
+            assert any(
+                set(c) == {"test.abba.a", "test.abba.b"} for c in cycles
+            ), cycles
+            found = [f for f in lc.findings() if f.code == "lock-cycle"
+                     and "test.abba.a" in f.where]
+            assert found and found[0].level == "error"
+            # Every edge of the cycle carries the stack that recorded it.
+            assert found[0].detail["stacks"]
+        finally:
+            lc.forget("test.")
+        assert not _test_cycles()
+
+    def test_consistent_order_is_clean(self):
+        a = lc.tracked_lock("test.clean.a")
+        b = lc.tracked_lock("test.clean.b")
+        try:
+            def worker():
+                with a:
+                    with b:
+                        pass
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join(5)
+            with a:
+                with b:
+                    pass
+            assert not _test_cycles()
+        finally:
+            lc.forget("test.")
+
+    def test_allow_env_suppresses_edge(self, monkeypatch):
+        a = lc.tracked_lock("test.sup.a")
+        b = lc.tracked_lock("test.sup.b")
+        try:
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            assert _test_cycles()
+            monkeypatch.setenv(
+                "DORA_LOCKCHECK_ALLOW", "test.sup.a>test.sup.b"
+            )
+            assert not _test_cycles()
+        finally:
+            lc.forget("test.")
+
+    def test_held_across_blocking_call(self):
+        lock = lc.tracked_lock("test.blk")
+        try:
+            with lock:
+                with pytest.raises(queue.Empty):
+                    queue.Queue().get(timeout=0.01)
+            found = [f for f in lc.findings()
+                     if f.code == "lock-blocking" and f.where == "test.blk"]
+            assert found and found[0].level == "warning"
+            assert found[0].detail["call"] == "queue.Queue.get"
+        finally:
+            lc.forget("test.")
+
+    def test_allow_blocking_lock_is_exempt(self):
+        lock = lc.tracked_lock("test.blk.ok", allow_blocking=True)
+        try:
+            with lock:
+                with pytest.raises(queue.Empty):
+                    queue.Queue().get(timeout=0.01)
+            assert not [f for f in lc.findings()
+                        if f.code == "lock-blocking"
+                        and f.where == "test.blk.ok"]
+        finally:
+            lc.forget("test.")
+
+    def test_rlock_stays_tracked_through_inner_release(self):
+        # Regression: the inner release of a reentrant hold must not
+        # drop the held-entry while the lock is still owned.
+        r = lc.tracked_rlock("test.reent")
+        try:
+            r.acquire()
+            r.acquire()
+            r.release()  # still held (depth 1)
+            with pytest.raises(queue.Empty):
+                queue.Queue().get(timeout=0.01)
+            r.release()
+            found = [f for f in lc.findings()
+                     if f.code == "lock-blocking" and f.where == "test.reent"]
+            assert found
+        finally:
+            lc.forget("test.")
+
+    def test_factory_returns_plain_lock_when_off(self):
+        was = lc.LOCKCHECK.active
+        lc.LOCKCHECK.active = False
+        try:
+            lock = lc.tracked_lock("test.off")
+            assert not isinstance(lock, lc.TrackedLock)
+            assert isinstance(lock, type(threading.Lock()))
+        finally:
+            lc.LOCKCHECK.active = was
+
+    def test_long_hold_reported(self, monkeypatch):
+        import dora_tpu.analysis.lockcheck as mod
+
+        monkeypatch.setattr(mod, "_HOLD_NS", 1)  # everything is "long"
+        lock = lc.tracked_lock("test.slow")
+        try:
+            with lock:
+                pass
+            found = [f for f in lc.findings()
+                     if f.code == "lock-long-hold" and f.where == "test.slow"]
+            assert found and found[0].level == "warning"
+        finally:
+            lc.forget("test.")
+
+
+def test_lint_lock_wiring_repo_is_clean():
+    import dora_tpu
+
+    from dora_tpu.analysis.lockcheck import lint_lock_wiring
+
+    import pathlib
+
+    assert lint_lock_wiring(pathlib.Path(dora_tpu.__file__).parent) == []
+
+
+# ---------------------------------------------------------------------------
+# graphcheck: descriptor contradictions
+# ---------------------------------------------------------------------------
+
+
+class TestGraphcheck:
+    def test_clean_pipeline(self):
+        d = parse("""
+            nodes:
+              - id: cam
+                path: python
+                inputs: {tick: dora/timer/millis/20}
+                outputs: [image]
+              - id: sink
+                path: python
+                inputs: {image: cam/image}
+        """)
+        assert check_descriptor(d) == []
+
+    def test_unfed_cycle_is_deadlock(self):
+        d = parse("""
+            nodes:
+              - id: a
+                path: python
+                inputs: {x: b/out}
+                outputs: [out]
+              - id: b
+                path: python
+                inputs: {x: a/out}
+                outputs: [out]
+        """)
+        found = check_descriptor(d)
+        assert "graph-cycle-deadlock" in codes(errors(found))
+        (f,) = [f for f in found if f.code == "graph-cycle-deadlock"]
+        assert set(f.detail["nodes"]) == {"a", "b"}
+
+    def test_timer_fed_cycle_is_fine(self):
+        d = parse("""
+            nodes:
+              - id: a
+                path: python
+                inputs:
+                  x: b/out
+                  tick: dora/timer/millis/100
+                outputs: [out]
+              - id: b
+                path: python
+                inputs: {x: a/out}
+                outputs: [out]
+        """)
+        assert "graph-cycle-deadlock" not in codes(check_descriptor(d))
+
+    def test_externally_fed_cycle_is_fine(self):
+        d = parse("""
+            nodes:
+              - id: src
+                path: python
+                inputs: {tick: dora/timer/millis/100}
+                outputs: [seed]
+              - id: a
+                path: python
+                inputs: {x: b/out, seed: src/seed}
+                outputs: [out]
+              - id: b
+                path: python
+                inputs: {x: a/out}
+                outputs: [out]
+        """)
+        assert "graph-cycle-deadlock" not in codes(check_descriptor(d))
+
+    def test_external_ingress_cycle_is_fine(self):
+        # openai-server example shape: the api node is driven by HTTP
+        # requests from outside the dataflow, so api -> llm -> api is
+        # not startup-deadlocked even with no timer anywhere.
+        d = parse("""
+            nodes:
+              - id: api
+                path: module:dora_tpu.nodehub.openai_server
+                inputs: {response: llm/out}
+                outputs: [text]
+              - id: llm
+                path: python
+                inputs: {text: api/text}
+                outputs: [out]
+        """)
+        assert "graph-cycle-deadlock" not in codes(check_descriptor(d))
+
+    def test_dangling_edge_all_reported(self):
+        d = parse("""
+            nodes:
+              - id: a
+                path: python
+                inputs: {x: ghost/out, y: b/nope}
+                outputs: [out]
+              - id: b
+                path: python
+                outputs: [real]
+        """)
+        found = [f for f in check_descriptor(d)
+                 if f.code == "graph-dangling-edge"]
+        assert len(found) == 2  # validate raises on the first; we get both
+
+    def test_restart_p2p_contradiction(self):
+        d = parse("""
+            nodes:
+              - id: src
+                path: python
+                inputs: {tick: dora/timer/millis/100}
+                outputs: [out]
+              - id: sink
+                path: python
+                restart: true
+                env: {DORA_P2P: "1"}
+                inputs: {x: src/out}
+        """)
+        found = check_descriptor(d)
+        assert "graph-restart-p2p" in codes(errors(found))
+
+    def test_restart_without_explicit_p2p_is_fine(self):
+        # Default-on p2p silently falls back to daemon routing for
+        # restartable receivers — only an explicit opt-in contradicts.
+        d = parse("""
+            nodes:
+              - id: src
+                path: python
+                inputs: {tick: dora/timer/millis/100}
+                outputs: [out]
+              - id: sink
+                path: python
+                restart: true
+                inputs: {x: src/out}
+        """)
+        assert "graph-restart-p2p" not in codes(check_descriptor(d))
+
+    def test_slo_on_non_serving_node(self):
+        d = parse("""
+            nodes:
+              - id: cam
+                path: python
+                inputs: {tick: dora/timer/millis/20}
+                outputs: [image]
+                slo: {ttft_p99_ms: 250}
+        """)
+        assert "graph-slo-non-serving" in codes(errors(check_descriptor(d)))
+
+    def test_slo_on_serving_node_is_fine(self):
+        d = parse("""
+            nodes:
+              - id: llm
+                path: module:dora_tpu.nodehub.llm_server
+                inputs: {prompt: api/out}
+                outputs: [tokens]
+                slo: {ttft_p99_ms: 250}
+              - id: api
+                path: python
+                inputs: {tick: dora/timer/millis/100}
+                outputs: [out]
+        """)
+        assert "graph-slo-non-serving" not in codes(check_descriptor(d))
+
+    def test_qos_deadline_below_window_quantum(self):
+        d = parse("""
+            nodes:
+              - id: llm
+                path: module:dora_tpu.nodehub.llm_server
+                env: {DORA_MULTISTEP_K: "16"}
+                inputs: {prompt: api/out}
+                outputs: [tokens]
+                qos: {shed_wait_ms: 4}
+              - id: api
+                path: python
+                inputs: {tick: dora/timer/millis/100}
+                outputs: [out]
+        """)
+        found = check_descriptor(d)
+        assert "graph-qos-deadline-quantum" in codes(errors(found))
+        (f,) = [f for f in found
+                if f.code == "graph-qos-deadline-quantum"]
+        assert f.detail["k"] == 16
+
+    def test_qos_sane_deadline_is_fine(self):
+        d = parse("""
+            nodes:
+              - id: llm
+                path: module:dora_tpu.nodehub.llm_server
+                inputs: {prompt: api/out}
+                outputs: [tokens]
+                qos: {shed_wait_ms: 1500}
+              - id: api
+                path: python
+                inputs: {tick: dora/timer/millis/100}
+                outputs: [out]
+        """)
+        assert not errors(check_descriptor(d))
+
+
+# ---------------------------------------------------------------------------
+# jaxlint: recompile-hazard fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestJaxlint:
+    def lint(self, tmp_path, src: str) -> list[Finding]:
+        f = tmp_path / "fixture.py"
+        f.write_text(textwrap.dedent(src))
+        return jaxlint.lint_file(f)
+
+    def test_tracer_branch_flagged(self, tmp_path):
+        found = self.lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert "jax-tracer-branch" in codes(found)
+
+    def test_shape_branch_is_concrete(self, tmp_path):
+        found = self.lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 1:
+                    return x[1:]
+                return x
+        """)
+        assert "jax-tracer-branch" not in codes(found)
+
+    def test_static_arg_branch_is_fine(self, tmp_path):
+        found = self.lint(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, mode):
+                if mode:
+                    return x + 1
+                return x
+        """)
+        assert "jax-tracer-branch" not in codes(found)
+
+    def test_unhashable_static_default(self, tmp_path):
+        found = self.lint(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, cfg=[1, 2]):
+                return x
+        """)
+        assert "jax-unhashable-static" in codes(found)
+
+    def test_missing_donate_on_pools(self, tmp_path):
+        found = self.lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(ids, pools):
+                return ids, pools
+        """)
+        assert "jax-missing-donate" in codes(found)
+
+    def test_donated_pools_is_fine(self, tmp_path):
+        found = self.lint(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def step(ids, pools):
+                return ids, pools
+        """)
+        assert "jax-missing-donate" not in codes(found)
+
+    def test_impure_call_flagged(self, tmp_path):
+        found = self.lint(tmp_path, """
+            import time
+
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x + time.time()
+        """)
+        assert "jax-impure-call" in codes(found)
+
+    def test_self_sweep_is_clean(self):
+        import pathlib
+
+        import dora_tpu
+
+        found = jaxlint.lint_self(pathlib.Path(dora_tpu.__file__).parent)
+        assert found == [], [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# envreg / wirecheck: repo-wide coverage lints stay clean
+# ---------------------------------------------------------------------------
+
+
+def test_env_registry_covers_all_reads():
+    import pathlib
+
+    import dora_tpu
+
+    pkg = pathlib.Path(dora_tpu.__file__).parent
+    found = envreg.lint_env_reads(pkg)
+    assert found == [], [f.render() for f in found]
+
+
+def test_env_readme_tables_match_registry():
+    import pathlib
+
+    import dora_tpu
+
+    readme = pathlib.Path(dora_tpu.__file__).parent.parent / "README.md"
+    found = envreg.lint_readme(readme)
+    assert found == [], [f.render() for f in found]
+
+
+def test_envreg_flags_undeclared_read(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        'import os\nX = os.environ.get("DORA_NOT_A_REAL_KNOB")\n'
+    )
+    found = envreg.lint_env_reads(tmp_path)
+    assert codes(found) == {"env-undeclared"}
+
+
+def test_envreg_flags_unregistered_literal(tmp_path):
+    (tmp_path / "mod.py").write_text('NAME = "DORA_NOT_A_REAL_KNOB"\n')
+    found = envreg.lint_env_reads(tmp_path)
+    assert codes(found) == {"env-unregistered-literal"}
+
+
+def test_wirecheck_every_message_has_codec_and_golden():
+    import pathlib
+
+    import dora_tpu
+
+    repo = pathlib.Path(dora_tpu.__file__).parent.parent
+    found = wirecheck.lint(repo)
+    assert found == [], [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# CLI integration
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_check_rejects_contradiction(self, tmp_path, capsys):
+        from dora_tpu.cli.main import build_parser
+
+        df = tmp_path / "flow.yml"
+        df.write_text(textwrap.dedent("""
+            nodes:
+              - id: a
+                path: python
+                inputs: {x: b/out}
+                outputs: [out]
+              - id: b
+                path: python
+                inputs: {x: a/out}
+                outputs: [out]
+        """))
+        args = build_parser().parse_args(["check", str(df), "--json"])
+        assert args.fn(args) == 1
+        out = capsys.readouterr().out
+        assert "graph-cycle-deadlock" in out
+
+    def test_check_ok(self, tmp_path, capsys):
+        from dora_tpu.cli.main import build_parser
+
+        df = tmp_path / "flow.yml"
+        df.write_text(textwrap.dedent("""
+            nodes:
+              - id: cam
+                path: python
+                inputs: {tick: dora/timer/millis/20}
+                outputs: [image]
+        """))
+        args = build_parser().parse_args(["check", str(df)])
+        assert args.fn(args) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_lint_paths_fixture(self, tmp_path, capsys):
+        from dora_tpu.cli.main import build_parser
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """))
+        args = build_parser().parse_args(["lint", str(bad), "--json"])
+        assert args.fn(args) == 1
+        assert "jax-tracer-branch" in capsys.readouterr().out
+
+    def test_lint_self_clean(self, capsys):
+        from dora_tpu.cli.main import build_parser
+
+        args = build_parser().parse_args(["lint", "--self"])
+        assert args.fn(args) == 0
