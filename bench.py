@@ -776,6 +776,42 @@ def serving_prefix_ab() -> dict:
     return data
 
 
+def serving_quant_ab() -> dict:
+    """Quantized-serving A/B (tools/bench_serving --quant-ab): fp-KV vs
+    int8-KV vs int8-KV + int4-weight engines on the identical prompt
+    set — tokens/s, greedy token agreement vs the fp leg, and the
+    capacity leg counting concurrent admissions into the same pool
+    byte budget. Headline: ``int8_capacity_ratio`` >= 1.8 (concurrent
+    streams in the fp pool's HBM footprint). Fresh subprocess for the
+    same accelerator-claim reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--quant-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "quant_ab" in row:
+            data = row["quant_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "greedy_agreement_vs_fp": None,
+            "capacity": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -1008,6 +1044,15 @@ def main() -> int:
         }
 
     try:
+        quant_ab = serving_quant_ab()
+    except Exception as exc:
+        quant_ab = {
+            "greedy_agreement_vs_fp": None,
+            "capacity": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -1048,6 +1093,7 @@ def main() -> int:
         "serving_profiling_ab": profiling_ab,
         "serving_qos_soak": qos_soak,
         "serving_prefix_ab": prefix_ab,
+        "serving_quant_ab": quant_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

@@ -127,6 +127,9 @@ REGISTRY: dict[str, EnvVar] = dict((
     _e("DORA_INT8_DECODE", "bool", "0", "int8 weight quantized decode", True),
     _e("DORA_INT8_PURE", "bool", "0", "pure-int8 matmul path"),
     _e("DORA_INT4_DECODE", "bool", "0", "int4 weight quantized decode", True),
+    _e("DORA_KV_INT8", "bool", "0", "int8 KV pages with per-page scales",
+       True),
+    _e("DORA_WEIGHT_BITS", "str", "", "decode weight bits (4 or 8)", True),
     _e("DORA_PARAM_DTYPE", "str", "", "parameter dtype override"),
     _e("DORA_SP_IMPL", "str", "", "sequence-parallel impl selector", True),
     _e("DORA_SPEC_DECODE", "bool", "0", "speculative decoding", True),

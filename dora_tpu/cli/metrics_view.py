@@ -33,7 +33,7 @@ _SPARK = " ▁▂▃▄▅▆▇█"
 _UTIL_KEYS = (
     "mfu", "device_busy_fraction", "hbm_used_bytes", "hbm_limit_bytes",
     "hbm_peak_bytes", "device_compute_ns", "host_dispatch_ns",
-    "device_fetch_ns",
+    "device_fetch_ns", "kv_dtype", "kv_pool_bytes", "kv_quant_err",
 )
 
 
@@ -311,6 +311,8 @@ def render_metrics(
                 if used is not None and limit is not None
                 else "-"
             )
+            pool = s.get("kv_pool_bytes")
+            qerr = s.get("kv_quant_err")
             util_rows.append([
                 nid,
                 f"{mfu * 100:.1f}%" if mfu is not None else "-",
@@ -320,11 +322,14 @@ def render_metrics(
                 f"{s.get('device_compute_ns', 0) / 1e6:.0f}ms",
                 f"{s.get('host_dispatch_ns', 0) / 1e6:.0f}ms",
                 f"{s.get('device_fetch_ns', 0) / 1e6:.0f}ms",
+                s.get("kv_dtype") or "-",
+                _fmt_bytes(pool) if pool is not None else "-",
+                f"{qerr * 100:.2f}%" if qerr is not None else "-",
             ])
         if util_rows:
             lines += [""] + _table(
                 ["UTIL", "MFU", "BUSY", "HBM", "HBM PEAK", "DEV",
-                 "DISP", "FETCH"],
+                 "DISP", "FETCH", "KV", "KV POOL", "QERR"],
                 util_rows,
             )
             # MFU sparkline over the watch history (one cell per

@@ -192,6 +192,7 @@ class ServingMetrics:
         "dispatched_flops", "useful_flops",
         "hbm_used_bytes", "hbm_limit_bytes", "hbm_peak_bytes",
         "mfu", "device_busy_fraction",
+        "kv_dtype", "kv_pool_bytes", "kv_quant_err",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -308,6 +309,14 @@ class ServingMetrics:
         #: and the fraction of wall time the device was computing
         self.mfu: float | None = None
         self.device_busy_fraction: float | None = None
+        #: quantized-serving plane: KV pool number format ("fp" or
+        #: "int8"), total pool HBM bytes (values + scale planes), and
+        #: the per-page quantization-error gauge (mean relative
+        #: quantization step over sampled allocated pages —
+        #: PagedBatchEngine.kv_quant_error; None on fp pools)
+        self.kv_dtype = "fp"
+        self.kv_pool_bytes: int | None = None
+        self.kv_quant_err: float | None = None
 
     def snapshot(self) -> dict:
         import time
@@ -390,6 +399,9 @@ class ServingMetrics:
             "hbm_peak_bytes": self.hbm_peak_bytes,
             "mfu": self.mfu,
             "device_busy_fraction": self.device_busy_fraction,
+            "kv_dtype": self.kv_dtype,
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "kv_quant_err": self.kv_quant_err,
         }
 
 

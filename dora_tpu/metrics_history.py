@@ -123,9 +123,16 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
         # monitor off, pre-round-16 snapshot): recorded only when real,
         # so history series never fabricate a zero-MFU sample.
         for name in ("mfu", "device_busy_fraction", "hbm_used_bytes",
-                     "hbm_limit_bytes", "hbm_peak_bytes"):
+                     "hbm_limit_bytes", "hbm_peak_bytes",
+                     "kv_pool_bytes", "kv_quant_err"):
             if s.get(name) is not None:
                 gauges[f"srv:{node}:{name}"] = s[name]
+        # kv_dtype is a string gauge; series store its 0/1 projection
+        # (same encoding as the dora_serving_kv_int8 prom family).
+        if s.get("kv_dtype") is not None:
+            gauges[f"srv:{node}:kv_int8"] = (
+                1 if s["kv_dtype"] == "int8" else 0
+            )
         for cls, d in (s.get("qos_depth") or {}).items():
             gauges[f"srv:{node}:qos_depth:{cls}"] = d
         ttft = s.get("ttft_us") or {}
@@ -433,7 +440,8 @@ def merge_history_snapshots(snapshots: list[dict]) -> dict:
 
 
 _UTIL_GAUGES = ("mfu", "device_busy_fraction", "hbm_used_bytes",
-                "hbm_limit_bytes", "hbm_peak_bytes")
+                "hbm_limit_bytes", "hbm_peak_bytes",
+                "kv_int8", "kv_pool_bytes", "kv_quant_err")
 
 
 def derive_util(samples: list[dict]) -> dict:

@@ -616,6 +616,16 @@ class PagedBatchEngine:
         #: peak FLOP/s for MFU's denominator — set by engine factories.
         self.flops_per_token = 0
         self.device_peak_flops = 0.0
+        #: KV number format, detected from the pool layout: int8 pools
+        #: carry parallel ``ks``/``vs`` scale planes per layer
+        #: (models/hf/qwen2.init_page_pool). Checkpoint custody keys on
+        #: this — an fp snapshot's page bytes are meaningless in an
+        #: int8 pool and vice versa, so restore_state rejects a
+        #: mismatch instead of silently corrupting pages.
+        first = next(iter(self.pools.values()), None)
+        self.kv_dtype = (
+            "int8" if isinstance(first, dict) and "ks" in first else "fp"
+        )
 
         def _set_slot(tokens, positions, token, pos, b):
             tokens = jax.lax.dynamic_update_slice(
@@ -1315,7 +1325,7 @@ class PagedBatchEngine:
                 # so dispatch counts) identical too.
                 meta["history"] = [int(t) for t in self._hist[b]]
             slots.append(meta)
-        return {"slots": slots}
+        return {"slots": slots, "kv_dtype": self.kv_dtype}
 
     def restore_state(self, state: dict, *, pin_slots: bool = True) -> list[str]:
         """Rebuild live streams from :meth:`checkpoint_state`; returns
@@ -1328,8 +1338,21 @@ class PagedBatchEngine:
         pages); without, any free slot/pages serve (the migrate-in path,
         where pools are not shipped). Mid-prefill streams re-submit from
         scratch — chunked prefill is deterministic and they emitted
-        nothing yet, so replaying the chunks is token-exact."""
+        nothing yet, so replaying the chunks is token-exact.
+
+        The snapshot's ``kv_dtype`` must match this engine's (missing
+        defaults to "fp" — pre-quantization snapshots): block tables
+        reference physical pages whose BYTES are format-specific, and
+        int8 pages additionally carry scale planes an fp engine has
+        nowhere to put. A mismatch raises instead of corrupting."""
         jnp = self._jnp
+        snap_dtype = state.get("kv_dtype", "fp")
+        if snap_dtype != self.kv_dtype:
+            raise ValueError(
+                f"checkpoint kv_dtype {snap_dtype!r} does not match engine "
+                f"kv_dtype {self.kv_dtype!r}: re-serve the snapshot on an "
+                f"engine built with the same DORA_KV_INT8 setting"
+            )
         restored: list[str] = []
         metas = state.get("slots", [])
         #: pages already claimed by an earlier slot of THIS restore —
@@ -1437,6 +1460,44 @@ class PagedBatchEngine:
         from dora_tpu.models import checkpoint
 
         self.pools = checkpoint.restore(path, self.pools)
+
+    def kv_pool_bytes(self) -> int:
+        """Total device bytes of the KV pool pytree — int8 pools count
+        their scale planes, so the gauge reflects the true HBM
+        footprint the capacity math is denominated in."""
+        import jax
+
+        return sum(
+            x.nbytes for x in jax.tree.leaves(self.pools)
+            if hasattr(x, "nbytes")
+        )
+
+    def kv_quant_error(self, sample_pages: int = 64) -> float | None:
+        """Per-page quantization error gauge for int8 pools: the mean
+        RELATIVE quantization step — ``scale / (2 * rms(dequantized
+        row) + eps)`` — over up to ``sample_pages`` allocated pages of
+        layer 0. It is computable from the pool alone (no fp shadow is
+        kept): symmetric rounding's worst-case per-element error is
+        scale/2, so this is the worst-case error as a fraction of the
+        row's RMS magnitude. None on fp pools (renders as a dash)."""
+        if self.kv_dtype != "int8":
+            return None
+        np = self._np
+        held = sorted(
+            p for p, c in self.allocator._ref.items() if c > 0 and p != 0
+        )[:sample_pages]
+        if not held:
+            return 0.0
+        idx = np.asarray(held)
+        lp = self.pools[next(iter(self.pools))]
+        errs = []
+        for name, sname in (("k", "ks"), ("v", "vs")):
+            q = np.asarray(lp[name][idx], np.float32)  # [n, KV, page, hd]
+            s = np.asarray(lp[sname][idx], np.float32)  # [n, KV, page]
+            deq = q * s[..., None]
+            rms = np.sqrt(np.mean(deq * deq, axis=-1))
+            errs.append(np.mean(s / (2.0 * rms + 1e-8)))
+        return float(np.mean(errs))
 
 
 def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,

@@ -124,13 +124,18 @@ def quantize_decode(params, cfg) -> dict:
     InternVL (whose text model IS this module). Serving gates:
     DORA_INT8_DECODE / DORA_INT4_DECODE / DORA_INT8_PURE; a tied head
     materializes from the embedding transpose (the embedding itself
-    stays float for the gather)."""
+    stays float for the gather). ``DORA_WEIGHT_BITS`` (8 or 4) is the
+    serving-plane spelling of the same choice: 4 selects the int4
+    grouped layout exactly like DORA_INT4_DECODE=1."""
     import os
 
     from dora_tpu.ops.int8_matmul import quantize_int8, quantize_tree
 
+    bits = os.environ.get("DORA_WEIGHT_BITS", "")
+    if bits and bits not in ("4", "8"):
+        raise ValueError(f"DORA_WEIGHT_BITS must be 4 or 8, got {bits!r}")
     quantizer = quantize_int8
-    if os.environ.get("DORA_INT4_DECODE"):
+    if os.environ.get("DORA_INT4_DECODE") or bits == "4":
         from dora_tpu.ops.int4 import quantize_int4 as quantizer  # noqa: F811
 
     keep_bf16 = not os.environ.get("DORA_INT8_PURE")
@@ -323,23 +328,46 @@ def fused_paged_chunk_step(params, cfg, chunk_ids, pools, position,
 
 
 def init_page_pool(cfg: Qwen2Config, num_pages: int, page_size: int,
-                   dtype=None):
+                   dtype=None, kv_int8: bool = False):
     """Per-layer paged KV pools: {layer: {k/v: [P, KV, page, hd]}}.
     Page 0 is reserved as the null page (idle slots' masked rows write
     there harmlessly); HBM scales with pages actually held, not
-    slots x max_seq."""
-    dtype = dtype or L.compute_dtype()
-    return {
+    slots x max_seq.
+
+    ``kv_int8`` makes the value pools int8 and adds parallel
+    ``ks``/``vs`` [P, KV, page] f32 scale planes (one scale per page
+    row per kv head — ops.decode_block.kv_quant_rows). The scale planes
+    live INSIDE the same per-layer pools dict, so every custody path
+    that moves pools as a pytree — donation through the window scan,
+    checkpoint save/restore, drain-and-migrate, prefix-cache page
+    sharing by table entry — carries values and scales atomically for
+    free."""
+    dtype = jnp.int8 if kv_int8 else (dtype or L.compute_dtype())
+    shape = (num_pages, cfg.kv_heads, page_size, cfg.head_dim)
+    pools = {
         str(i): {
-            "k": jnp.zeros(
-                (num_pages, cfg.kv_heads, page_size, cfg.head_dim), dtype
-            ),
-            "v": jnp.zeros(
-                (num_pages, cfg.kv_heads, page_size, cfg.head_dim), dtype
-            ),
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
         }
         for i in range(cfg.layers)
     }
+    if kv_int8:
+        sshape = (num_pages, cfg.kv_heads, page_size)
+        for lp in pools.values():
+            lp["ks"] = jnp.zeros(sshape, jnp.float32)
+            lp["vs"] = jnp.zeros(sshape, jnp.float32)
+    return pools
+
+
+def page_pool_bytes(cfg: Qwen2Config, page_size: int,
+                    kv_int8: bool = False) -> int:
+    """Per-page HBM bytes of one layer's K+V (+ scales when int8) —
+    the unit the engine's capacity math and the int8 default pool
+    sizing are denominated in."""
+    values = 2 * cfg.kv_heads * page_size * cfg.head_dim
+    if kv_int8:
+        return values * 1 + 2 * cfg.kv_heads * page_size * 4  # int8 + f32
+    return values * jnp.dtype(L.compute_dtype()).itemsize
 
 
 def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
@@ -350,7 +378,8 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
                       spec_k: int | None = None,
                       spec_ngram: int | None = None,
                       prefix_cache: bool | None = None,
-                      prefix_cache_pages: int | None = None):
+                      prefix_cache_pages: int | None = None,
+                      kv_int8: bool | None = None):
     """Paged-KV continuous-batching engine (requires the quantized fused
     layout, like :func:`make_batch_engine`). Defaults size the pool to
     EXACTLY the dense engine's 4-slot HBM footprint (4 * max_seq KV
@@ -385,8 +414,18 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         "DORA_INT4_DECODE)"
     )
     chunk = chunk or min(256, cfg.max_seq)
+    if kv_int8 is None:
+        kv_int8 = os.environ.get("DORA_KV_INT8", "0") != "0"
     if num_pages is None:
         num_pages = 4 * cfg.max_seq // page_size
+        if kv_int8:
+            # Same HBM byte budget as the fp default, denominated in
+            # int8 pages (values + scale planes) — this ratio IS the
+            # capacity multiplier the quant-ab bench measures.
+            budget = num_pages * page_pool_bytes(cfg, page_size)
+            num_pages = int(
+                budget // page_pool_bytes(cfg, page_size, kv_int8=True)
+            )
     if window is None:
         window = int(os.environ.get("DORA_MULTISTEP_K", "8"))
     if spec_k is None:
@@ -439,7 +478,8 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         donate_argnums=(1,),
     )
     engine = PagedBatchEngine(
-        init_pool=lambda n: init_page_pool(cfg, n, page_size),
+        init_pool=lambda n: init_page_pool(cfg, n, page_size,
+                                           kv_int8=kv_int8),
         chunk_prefill=chunk_fn,
         window_step=window_fn,
         window_factory=window_factory,

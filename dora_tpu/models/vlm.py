@@ -501,9 +501,18 @@ def fused_paged_pass_batch(params, x, pools, positions, block_tables,
     from dora_tpu.ops import decode_block as DB
 
     def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        lp = pools[str(i)]
+        if "ks" in lp:  # int8-KV pools carry parallel scale planes
+            x, kp, vp, ksp, vsp = DB.attention_paged_batch_step(
+                x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+                lp["k"], lp["v"], wo, swo, positions, block_tables,
+                lp["ks"], lp["vs"],
+                heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
+            )
+            return x, {"k": kp, "v": vp, "ks": ksp, "vs": vsp}
         x, kp, vp = DB.attention_paged_batch_step(
             x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
-            pools[str(i)]["k"], pools[str(i)]["v"], wo, swo, positions,
+            lp["k"], lp["v"], wo, swo, positions,
             block_tables,
             heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
         )
@@ -530,9 +539,18 @@ def fused_paged_pass_chunk(params, x, pools, position, block_table,
     from dora_tpu.ops import decode_block as DB
 
     def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        lp = pools[str(i)]
+        if "ks" in lp:  # int8-KV pools carry parallel scale planes
+            x, kp, vp, ksp, vsp = DB.attention_paged_chunk_step(
+                x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+                lp["k"], lp["v"], wo, swo, position, block_table,
+                lp["ks"], lp["vs"],
+                heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
+            )
+            return x, {"k": kp, "v": vp, "ks": ksp, "vs": vsp}
         x, kp, vp = DB.attention_paged_chunk_step(
             x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
-            pools[str(i)]["k"], pools[str(i)]["v"], wo, swo, position,
+            lp["k"], lp["v"], wo, swo, position,
             block_table,
             heads=heads, kv_heads=kv_heads, head_dim=head_dim, eps=eps,
         )
@@ -560,9 +578,19 @@ def fused_paged_pass_spec(params, x, pools, positions, block_tables,
     from dora_tpu.ops import decode_block as DB
 
     def attn_apply(i, x, blk, wqkv, sqkv, bqkv, wo, swo):
+        lp = pools[str(i)]
+        if "ks" in lp:  # int8-KV pools carry parallel scale planes
+            x, kp, vp, ksp, vsp = DB.attention_paged_spec_step(
+                x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
+                lp["k"], lp["v"], wo, swo, positions, block_tables,
+                lp["ks"], lp["vs"],
+                heads=heads, kv_heads=kv_heads, head_dim=head_dim, m=m,
+                eps=eps,
+            )
+            return x, {"k": kp, "v": vp, "ks": ksp, "vs": vsp}
         x, kp, vp = DB.attention_paged_spec_step(
             x, blk["attn_norm"], wqkv, sqkv, bqkv, cos_rows, sin_rows,
-            pools[str(i)]["k"], pools[str(i)]["v"], wo, swo, positions,
+            lp["k"], lp["v"], wo, swo, positions,
             block_tables,
             heads=heads, kv_heads=kv_heads, head_dim=head_dim, m=m, eps=eps,
         )

@@ -995,6 +995,11 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 metrics.prefix_shared_pages = engine.shared_pages
                 metrics.prefix_cow_copies = pc.cow_copies
                 metrics.prefix_evictions = pc.evicted_pages
+            metrics.kv_dtype = getattr(engine, "kv_dtype", "fp")
+            if hasattr(engine, "kv_pool_bytes"):
+                metrics.kv_pool_bytes = engine.kv_pool_bytes()
+            if hasattr(engine, "kv_quant_error"):
+                metrics.kv_quant_err = engine.kv_quant_error()
         metrics.qos_depth = backlog.depths()
         metrics.autotune_k = getattr(engine, "window", 0)
         if monitor is not None:

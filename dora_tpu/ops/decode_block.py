@@ -115,6 +115,33 @@ def _rotate(x, cos_full, sin_signed, half: int):
     return x * cos_full + swapped * sin_signed
 
 
+def kv_quant_rows(x):
+    """Symmetric int8 row quantization over the last axis.
+
+    The single definition of the KV-page number format: every
+    quantize-on-write site in the paged kernels AND the plain-JAX
+    reference in tests/test_kv_int8.py call THIS function, so kernel
+    pool bytes are bitwise-checkable against the reference. Returns
+    ``(q int8, scale f32)`` with ``scale`` shaped like ``x`` minus the
+    last axis — one scale per (row, kv-head) so a page carries a
+    [KV, page] scale plane parallel to its [KV, page, hd] values.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -127.0, 127.0
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequant(q, scale, dtype):
+    """Inverse of :func:`kv_quant_rows` at the sweep's read edge —
+    dequantize int8 page values back to the compute dtype in-register.
+    Shared with the test reference for the same bitwise reason."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 # ---------------------------------------------------------------------------
 # attention block
 # ---------------------------------------------------------------------------
@@ -886,18 +913,34 @@ def attention_batch_step(
 def _attn_paged_batch_kernel(
     pos_ref,  # SMEM (B,) int32 — per-row positions
     bt_ref,   # SMEM (B, max_pages) int32 — per-row block tables
-    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
-    kp_in, vp_in, wo_ref, swo_ref,
-    out_ref, kp_out, vp_out,
-    kv_row, kblk, vblk, sem, wsem,
-    *, heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
-    batch: int, residual: bool,
+    *refs,
+    heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
+    batch: int, residual: bool, kv_quant: bool = False,
 ):
     """B-row decode over B independent sequences whose K/V live in a
     shared page pool [P, KV, page, hd]. Identical math to
     :func:`_attn_batch_kernel`; only the HBM addressing changes — the
     flash sweep walks pool pages through the row's block table, and the
-    in-place row write targets the row's CURRENT page."""
+    in-place row write targets the row's CURRENT page.
+
+    ``kv_quant`` adds the int8-KV pools: values are
+    :func:`kv_quant_rows`-quantized in-register right before the RMW
+    insert, per-(row, kv-head) f32 scales ride parallel [P, KV, page]
+    scale pools through the SAME page ids, and the flash sweep
+    dequantizes each streamed page in-register — HBM traffic per page
+    is the int8 bytes plus a [KV, page] scale plane. The current row's
+    fold stays exact fp from registers either way (it never round-trips
+    the pool within its own step)."""
+    if kv_quant:
+        (x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+         kp_in, vp_in, ks_in, vs_in, wo_ref, swo_ref,
+         out_ref, kp_out, vp_out, ks_out, vs_out,
+         kv_row, s_row, kblk, vblk, sblk, sem, wsem) = refs
+    else:
+        (x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+         kp_in, vp_in, wo_ref, swo_ref,
+         out_ref, kp_out, vp_out,
+         kv_row, kblk, vblk, sem, wsem) = refs
     half = head_dim // 2
     dtype = x_ref.dtype
     int4 = wqkv_ref.dtype == jnp.uint8
@@ -942,39 +985,80 @@ def _attn_paged_batch_kernel(
         cur = bt_ref[b, pos // page]
         inpage = pos - pos // page * page
         aligned = pl.multiple_of(inpage // 8 * 8, 8)
-        rd_k = pltpu.make_async_copy(
-            kp_out.at[cur, :, pl.ds(aligned, 8), :], kv_row.at[0, b],
-            sem.at[0],
-        )
-        rd_v = pltpu.make_async_copy(
-            vp_out.at[cur, :, pl.ds(aligned, 8), :], kv_row.at[1, b],
-            sem.at[1],
-        )
-        rd_k.start()
-        rd_v.start()
-        rd_k.wait()
-        rd_v.wait()
+        reads = [
+            pltpu.make_async_copy(
+                kp_out.at[cur, :, pl.ds(aligned, 8), :], kv_row.at[0, b],
+                sem.at[0],
+            ),
+            pltpu.make_async_copy(
+                vp_out.at[cur, :, pl.ds(aligned, 8), :], kv_row.at[1, b],
+                sem.at[1],
+            ),
+        ]
+        if kv_quant:
+            # The 8-row scale windows RMW alongside the value windows:
+            # old rows keep their scales (written once, never
+            # requantized), only the current row's slot is replaced.
+            reads += [
+                pltpu.make_async_copy(
+                    ks_out.at[cur, :, pl.ds(aligned, 8)], s_row.at[0, b],
+                    sem.at[6],
+                ),
+                pltpu.make_async_copy(
+                    vs_out.at[cur, :, pl.ds(aligned, 8)], s_row.at[1, b],
+                    sem.at[7],
+                ),
+            ]
+        for rd in reads:
+            rd.start()
+        for rd in reads:
+            rd.wait()
         row_sel = (
             jax.lax.broadcasted_iota(jnp.int32, (kv_heads, 8, head_dim), 1)
             == inpage - aligned
         )
-        kv_row[0, b] = jnp.where(
-            row_sel, k_b[b][:, None, :].astype(kv_row.dtype), kv_row[0, b]
-        )
-        kv_row[1, b] = jnp.where(
-            row_sel, v_b[b][:, None, :].astype(kv_row.dtype), kv_row[1, b]
-        )
-        wr_k = pltpu.make_async_copy(
-            kv_row.at[0, b], kp_out.at[cur, :, pl.ds(aligned, 8), :],
-            wsem.at[0, b],
-        )
-        wr_v = pltpu.make_async_copy(
-            kv_row.at[1, b], vp_out.at[cur, :, pl.ds(aligned, 8), :],
-            wsem.at[1, b],
-        )
-        wr_k.start()
-        wr_v.start()
-        pending += [wr_k, wr_v]
+        if kv_quant:
+            kq, ksc = kv_quant_rows(k_b[b])
+            vq, vsc = kv_quant_rows(v_b[b])
+            kv_row[0, b] = jnp.where(row_sel, kq[:, None, :], kv_row[0, b])
+            kv_row[1, b] = jnp.where(row_sel, vq[:, None, :], kv_row[1, b])
+            s_sel = (
+                jax.lax.broadcasted_iota(jnp.int32, (kv_heads, 8), 1)
+                == inpage - aligned
+            )
+            s_row[0, b] = jnp.where(s_sel, ksc[:, None], s_row[0, b])
+            s_row[1, b] = jnp.where(s_sel, vsc[:, None], s_row[1, b])
+        else:
+            kv_row[0, b] = jnp.where(
+                row_sel, k_b[b][:, None, :].astype(kv_row.dtype), kv_row[0, b]
+            )
+            kv_row[1, b] = jnp.where(
+                row_sel, v_b[b][:, None, :].astype(kv_row.dtype), kv_row[1, b]
+            )
+        writes = [
+            pltpu.make_async_copy(
+                kv_row.at[0, b], kp_out.at[cur, :, pl.ds(aligned, 8), :],
+                wsem.at[0, b],
+            ),
+            pltpu.make_async_copy(
+                kv_row.at[1, b], vp_out.at[cur, :, pl.ds(aligned, 8), :],
+                wsem.at[1, b],
+            ),
+        ]
+        if kv_quant:
+            writes += [
+                pltpu.make_async_copy(
+                    s_row.at[0, b], ks_out.at[cur, :, pl.ds(aligned, 8)],
+                    wsem.at[2, b],
+                ),
+                pltpu.make_async_copy(
+                    s_row.at[1, b], vs_out.at[cur, :, pl.ds(aligned, 8)],
+                    wsem.at[3, b],
+                ),
+            ]
+        for wr in writes:
+            wr.start()
+        pending += writes
 
     # --- per-row flash sweep: pool pages through the block table ------------
     attn_rows = []
@@ -986,20 +1070,35 @@ def _attn_paged_batch_kernel(
         def body(blk, carry, pos=pos, qb=qb, b=b):
             m_run, l_run, acc = carry
             pg = bt_ref[b, blk]
-            kcp = pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2])
-            vcp = pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3])
-            kcp.start()
-            vcp.start()
-            kcp.wait()
-            vcp.wait()
+            copies = [
+                pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2]),
+                pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3]),
+            ]
+            if kv_quant:
+                copies += [
+                    pltpu.make_async_copy(
+                        ks_out.at[pg], sblk.at[0], sem.at[4]
+                    ),
+                    pltpu.make_async_copy(
+                        vs_out.at[pg], sblk.at[1], sem.at[5]
+                    ),
+                ]
+            for cp in copies:
+                cp.start()
+            for cp in copies:
+                cp.wait()
             live = (
                 jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) + blk * page
             ) < pos
             scores = []
             for g in range(kv_heads):
+                if kv_quant:
+                    k_g = kv_dequant(kblk[g], sblk[0, g], dtype)
+                else:
+                    k_g = kblk[g].astype(dtype)
                 s_g = jax.lax.dot_general(
                     qb[g * group : (g + 1) * group].astype(dtype),
-                    kblk[g].astype(dtype),
+                    k_g,
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
@@ -1012,10 +1111,14 @@ def _attn_paged_batch_kernel(
             l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = []
             for g in range(kv_heads):
+                if kv_quant:
+                    v_g = kv_dequant(vblk[g], sblk[1, g], dtype)
+                else:
+                    v_g = vblk[g].astype(dtype)
                 pv.append(
                     jax.lax.dot(
                         p[g * group : (g + 1) * group].astype(dtype),
-                        vblk[g].astype(dtype),
+                        v_g,
                         preferred_element_type=jnp.float32,
                     )
                 )
@@ -1059,8 +1162,9 @@ def _attn_paged_batch_kernel(
 )
 def attention_paged_batch_step(
     x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_pool, v_pool,
-    wo, swo, positions, block_tables, *, heads: int, kv_heads: int,
-    head_dim: int, eps: float = 1e-6, residual: bool = True,
+    wo, swo, positions, block_tables, k_scale=None, v_scale=None,
+    *, heads: int, kv_heads: int, head_dim: int, eps: float = 1e-6,
+    residual: bool = True,
 ):
     """Fused paged decode attention for B independent sequences.
 
@@ -1070,17 +1174,63 @@ def attention_paged_batch_step(
     [B, max_pages] int32 physical page ids (0 = the reserved idle page).
     Weight layout matches :func:`attention_batch_step`. Returns
     (x_out [B, D], k_pool, v_pool).
+
+    ``k_scale``/``v_scale`` (both or neither) switch on the int8-KV
+    path: pools must be int8 and the scales are parallel [P, KV, page]
+    f32 pools indexed by the SAME physical page ids — sharing,
+    copy-on-write and migration stay block-table tricks because a page
+    id resolves values and scales together. Quantization happens
+    in-register before the row write (:func:`kv_quant_rows`),
+    dequantization in-register during the sweep (:func:`kv_dequant`).
+    Returns (x_out, k_pool, v_pool, k_scale, v_scale) in that mode.
+    The None/array distinction changes the jit pytree, so fp callers
+    trace the exact pre-quant program — byte-identical specs.
     """
+    kv_quant = k_scale is not None
+    assert kv_quant == (v_scale is not None)
     batch = x.shape[0]
     page = k_pool.shape[2]
     assert page % 8 == 0, page
+    if kv_quant:
+        assert k_pool.dtype == jnp.int8 and v_pool.dtype == jnp.int8, (
+            "int8-KV path needs int8 pools", k_pool.dtype
+        )
     d = x.shape[-1]
     n_qkv = wqkv.shape[1]
     kernel = functools.partial(
         _attn_paged_batch_kernel, heads=heads, kv_heads=kv_heads,
         head_dim=head_dim, page=page, eps=eps, batch=batch,
-        residual=residual,
+        residual=residual, kv_quant=kv_quant,
     )
+    pool_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
+        pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+    ]
+    pool_outs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    pool_shapes = [
+        jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+        jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+    ]
+    scale_scratch = []
+    if kv_quant:
+        pool_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k_scale (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),  # v_scale (HBM)
+        ]
+        pool_outs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        pool_shapes += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        scale_scratch = [
+            pltpu.VMEM((2, batch, kv_heads, 8), jnp.float32),  # s_row
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(1,),
@@ -1092,24 +1242,30 @@ def attention_paged_batch_step(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
             pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
-            pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
-            pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+            *pool_specs,
             pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
             pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *pool_outs,
         ],
         scratch_shapes=[
             pltpu.VMEM((2, batch, kv_heads, 8, head_dim), k_pool.dtype),
+            *scale_scratch,
             pltpu.VMEM((kv_heads, page, head_dim), k_pool.dtype),
             pltpu.VMEM((kv_heads, page, head_dim), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((4,)),
-            pltpu.SemaphoreType.DMA((2, batch)),
+            *(
+                [pltpu.VMEM((2, kv_heads, page), jnp.float32)]  # sblk
+                if kv_quant else []
+            ),
+            pltpu.SemaphoreType.DMA((8 if kv_quant else 4,)),
+            pltpu.SemaphoreType.DMA((4 if kv_quant else 2, batch)),
         ],
     )
+    operands = [k_pool, v_pool]
+    if kv_quant:
+        operands += [k_scale, v_scale]
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1117,12 +1273,13 @@ def attention_paged_batch_step(
             jax.ShapeDtypeStruct(
                 (batch, d), x.dtype if residual else jnp.float32
             ),
-            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
-            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            *pool_shapes,
         ],
         # positional arg i (0-based, INCLUDING the 2 scalar prefetches)
-        # -> output j: pools update in place.
-        input_output_aliases={9: 1, 10: 2},
+        # -> output j: pools (and scale pools) update in place.
+        input_output_aliases=(
+            {9: 1, 10: 2, 11: 3, 12: 4} if kv_quant else {9: 1, 10: 2}
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
@@ -1131,25 +1288,37 @@ def attention_paged_batch_step(
         jnp.asarray(positions, jnp.int32).reshape(batch),
         jnp.asarray(block_tables, jnp.int32),
         x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
-        cos_rows, sin_rows, k_pool, v_pool, wo, swo,
+        cos_rows, sin_rows, *operands, wo, swo,
     )
 
 
 def _attn_paged_chunk_kernel(
     pos_ref,  # SMEM (1,) int32 — chunk start (multiple of page)
     bt_ref,   # SMEM (max_pages,) int32 — this slot's block table
-    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
-    kp_in, vp_in, wo_ref, swo_ref,
-    out_ref, kp_out, vp_out,
-    kv_win, kblk, vblk, sem, wsem,
-    *, heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
-    m: int, residual: bool,
+    *refs,
+    heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
+    m: int, residual: bool, kv_quant: bool = False,
 ):
     """M-row chunked-prefill step for ONE slot: rows occupy positions
     pos..pos+m-1, attend the prior paged context (idx < pos, streamed
     through the block table) plus each other causally from registers.
     ``pos`` and ``m`` are multiples of ``page``, so the chunk's K/V
-    write covers m/page WHOLE pool pages — no read-modify-write."""
+    write covers m/page WHOLE pool pages — no read-modify-write.
+
+    ``kv_quant``: the whole chunk quantizes in-register before the page
+    writes (:func:`kv_quant_rows` — whole pages, so no scale RMW
+    either) and the prior-context sweep dequantizes each streamed page;
+    the within-chunk causal fold uses the exact fp registers."""
+    if kv_quant:
+        (x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+         kp_in, vp_in, ks_in, vs_in, wo_ref, swo_ref,
+         out_ref, kp_out, vp_out, ks_out, vs_out,
+         kv_win, s_win, kblk, vblk, sblk, sem, wsem) = refs
+    else:
+        (x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+         kp_in, vp_in, wo_ref, swo_ref,
+         out_ref, kp_out, vp_out,
+         kv_win, kblk, vblk, sem, wsem) = refs
     pos = pos_ref[0]
     half = head_dim // 2
     dtype = x_ref.dtype
@@ -1184,22 +1353,43 @@ def _attn_paged_chunk_kernel(
     v_m = vf.reshape(m, kv_heads, head_dim)
 
     # --- whole-page chunk write (overlapped with the sweep) -----------------
-    kv_win[0] = k_m.transpose(1, 0, 2).astype(kv_win.dtype)  # [KV, M, hd]
-    kv_win[1] = v_m.transpose(1, 0, 2).astype(kv_win.dtype)
+    if kv_quant:
+        kq, ksc = kv_quant_rows(k_m)  # [M, KV, hd] int8, [M, KV] f32
+        vq, vsc = kv_quant_rows(v_m)
+        kv_win[0] = kq.transpose(1, 0, 2)  # [KV, M, hd]
+        kv_win[1] = vq.transpose(1, 0, 2)
+        s_win[0] = ksc.transpose(1, 0)  # [KV, M]
+        s_win[1] = vsc.transpose(1, 0)
+    else:
+        kv_win[0] = k_m.transpose(1, 0, 2).astype(kv_win.dtype)  # [KV, M, hd]
+        kv_win[1] = v_m.transpose(1, 0, 2).astype(kv_win.dtype)
     pending = []
     for j in range(m // page):
         pg = bt_ref[pos // page + j]
-        wr_k = pltpu.make_async_copy(
-            kv_win.at[0, :, pl.ds(j * page, page), :], kp_out.at[pg],
-            wsem.at[0, j],
-        )
-        wr_v = pltpu.make_async_copy(
-            kv_win.at[1, :, pl.ds(j * page, page), :], vp_out.at[pg],
-            wsem.at[1, j],
-        )
-        wr_k.start()
-        wr_v.start()
-        pending += [wr_k, wr_v]
+        writes = [
+            pltpu.make_async_copy(
+                kv_win.at[0, :, pl.ds(j * page, page), :], kp_out.at[pg],
+                wsem.at[0, j],
+            ),
+            pltpu.make_async_copy(
+                kv_win.at[1, :, pl.ds(j * page, page), :], vp_out.at[pg],
+                wsem.at[1, j],
+            ),
+        ]
+        if kv_quant:
+            writes += [
+                pltpu.make_async_copy(
+                    s_win.at[0, :, pl.ds(j * page, page)], ks_out.at[pg],
+                    wsem.at[2, j],
+                ),
+                pltpu.make_async_copy(
+                    s_win.at[1, :, pl.ds(j * page, page)], vs_out.at[pg],
+                    wsem.at[3, j],
+                ),
+            ]
+        for wr in writes:
+            wr.start()
+        pending += writes
 
     # --- flash sweep over the prior paged context (idx < pos) ---------------
     nblocks = pos // page  # pos is page-aligned: all prior pages are full
@@ -1208,20 +1398,31 @@ def _attn_paged_chunk_kernel(
     def body(blk, carry):
         m_run, l_run, acc = carry
         pg = bt_ref[blk]
-        kcp = pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2])
-        vcp = pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3])
-        kcp.start()
-        vcp.start()
-        kcp.wait()
-        vcp.wait()
+        copies = [
+            pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2]),
+            pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3]),
+        ]
+        if kv_quant:
+            copies += [
+                pltpu.make_async_copy(ks_out.at[pg], sblk.at[0], sem.at[4]),
+                pltpu.make_async_copy(vs_out.at[pg], sblk.at[1], sem.at[5]),
+            ]
+        for cp in copies:
+            cp.start()
+        for cp in copies:
+            cp.wait()
         q4 = q.reshape(m, heads, head_dim)
         outs = []
         for g in range(kv_heads):
             q_g = q4[:, g * group : (g + 1) * group, :].reshape(
                 rows, head_dim
             )
+            if kv_quant:
+                k_g = kv_dequant(kblk[g], sblk[0, g], dtype)
+            else:
+                k_g = kblk[g].astype(dtype)
             s_g = jax.lax.dot_general(
-                q_g.astype(dtype), kblk[g].astype(dtype),
+                q_g.astype(dtype), k_g,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [rows, page]
@@ -1233,10 +1434,14 @@ def _attn_paged_chunk_kernel(
         l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = []
         for g in range(kv_heads):
+            if kv_quant:
+                v_g = kv_dequant(vblk[g], sblk[1, g], dtype)
+            else:
+                v_g = vblk[g].astype(dtype)
             pv.append(
                 jax.lax.dot(
                     p[g * rows : (g + 1) * rows].astype(dtype),
-                    vblk[g].astype(dtype),
+                    v_g,
                     preferred_element_type=jnp.float32,
                 )
             )
@@ -1299,8 +1504,9 @@ def _attn_paged_chunk_kernel(
 )
 def attention_paged_chunk_step(
     x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_pool, v_pool,
-    wo, swo, position, block_table, *, heads: int, kv_heads: int,
-    head_dim: int, eps: float = 1e-6, residual: bool = True,
+    wo, swo, position, block_table, k_scale=None, v_scale=None,
+    *, heads: int, kv_heads: int, head_dim: int, eps: float = 1e-6,
+    residual: bool = True,
 ):
     """M-row paged attention sublayer (chunked prefill).
 
@@ -1309,15 +1515,55 @@ def attention_paged_chunk_step(
     block_table: [max_pages] int32 for THIS slot. The chunk's K/V land as
     whole pool pages; prior context streams through the table. Returns
     (x_out [M, D], k_pool, v_pool).
+
+    ``k_scale``/``v_scale`` switch on the int8-KV path (see
+    :func:`attention_paged_batch_step`) and the return grows to
+    (x_out, k_pool, v_pool, k_scale, v_scale).
     """
+    kv_quant = k_scale is not None
+    assert kv_quant == (v_scale is not None)
     m, d = x.shape
     page = k_pool.shape[2]
     assert page % 8 == 0 and m % page == 0, (m, page)
+    if kv_quant:
+        assert k_pool.dtype == jnp.int8 and v_pool.dtype == jnp.int8, (
+            "int8-KV path needs int8 pools", k_pool.dtype
+        )
     n_qkv = wqkv.shape[1]
     kernel = functools.partial(
         _attn_paged_chunk_kernel, heads=heads, kv_heads=kv_heads,
         head_dim=head_dim, page=page, eps=eps, m=m, residual=residual,
+        kv_quant=kv_quant,
     )
+    pool_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
+        pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+    ]
+    pool_outs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    pool_shapes = [
+        jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+        jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+    ]
+    scale_scratch = []
+    if kv_quant:
+        pool_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k_scale (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),  # v_scale (HBM)
+        ]
+        pool_outs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        pool_shapes += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        scale_scratch = [
+            pltpu.VMEM((2, kv_heads, m), jnp.float32),  # s_win
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(1,),
@@ -1329,24 +1575,30 @@ def attention_paged_chunk_step(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
             pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
-            pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
-            pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+            *pool_specs,
             pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
             pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *pool_outs,
         ],
         scratch_shapes=[
             pltpu.VMEM((2, kv_heads, m, head_dim), k_pool.dtype),  # kv_win
+            *scale_scratch,
             pltpu.VMEM((kv_heads, page, head_dim), k_pool.dtype),
             pltpu.VMEM((kv_heads, page, head_dim), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((4,)),
-            pltpu.SemaphoreType.DMA((2, m // page)),
+            *(
+                [pltpu.VMEM((2, kv_heads, page), jnp.float32)]  # sblk
+                if kv_quant else []
+            ),
+            pltpu.SemaphoreType.DMA((6 if kv_quant else 4,)),
+            pltpu.SemaphoreType.DMA((4 if kv_quant else 2, m // page)),
         ],
     )
+    operands = [k_pool, v_pool]
+    if kv_quant:
+        operands += [k_scale, v_scale]
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1354,10 +1606,11 @@ def attention_paged_chunk_step(
             jax.ShapeDtypeStruct(
                 (m, d), x.dtype if residual else jnp.float32
             ),
-            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
-            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            *pool_shapes,
         ],
-        input_output_aliases={9: 1, 10: 2},
+        input_output_aliases=(
+            {9: 1, 10: 2, 11: 3, 12: 4} if kv_quant else {9: 1, 10: 2}
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
@@ -1366,19 +1619,17 @@ def attention_paged_chunk_step(
         jnp.asarray([position], jnp.int32).reshape(1),
         jnp.asarray(block_table, jnp.int32),
         x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
-        cos_rows, sin_rows, k_pool, v_pool, wo, swo,
+        cos_rows, sin_rows, *operands, wo, swo,
     )
 
 
 def _attn_paged_spec_kernel(
     pos_ref,  # SMEM (B,) int32 — per-stream chunk START positions
     bt_ref,   # SMEM (B, max_pages) int32 — per-stream block tables
-    x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
-    kp_in, vp_in, wo_ref, swo_ref,
-    out_ref, kp_out, vp_out,
-    kv_win, kblk, vblk, sem, wsem,
-    *, heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
+    *refs,
+    heads: int, kv_heads: int, head_dim: int, page: int, eps: float,
     batch: int, m: int, win: int, seq: int, residual: bool,
+    kv_quant: bool = False,
 ):
     """B independent speculative-verify chunks over paged KV: stream b's
     m rows (rows b*m..(b+1)*m-1 of x) occupy positions
@@ -1392,7 +1643,23 @@ def _attn_paged_spec_kernel(
     8-row groups — page size is a multiple of 8 and the groups are
     8-aligned, so each group lives wholly inside ONE pool page and maps
     through the block table independently. A frozen stream (pos 0,
-    zeroed table row) dumps all m rows into the reserved null page."""
+    zeroed table row) dumps all m rows into the reserved null page.
+
+    ``kv_quant``: each stream's m rows quantize in-register before the
+    group inserts (:func:`kv_quant_rows`; the [KV, win] scale window
+    RMWs in the same page-safe 8-row groups) and the prior-context
+    sweep dequantizes each streamed page; the within-chunk causal fold
+    stays exact fp from registers."""
+    if kv_quant:
+        (x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+         kp_in, vp_in, ks_in, vs_in, wo_ref, swo_ref,
+         out_ref, kp_out, vp_out, ks_out, vs_out,
+         kv_win, s_win, kblk, vblk, sblk, sem, wsem) = refs
+    else:
+        (x_ref, nw_ref, wqkv_ref, sqkv_ref, bqkv_ref, cos_ref, sin_ref,
+         kp_in, vp_in, wo_ref, swo_ref,
+         out_ref, kp_out, vp_out,
+         kv_win, kblk, vblk, sem, wsem) = refs
     half = head_dim // 2
     dtype = x_ref.dtype
     int4 = wqkv_ref.dtype == jnp.uint8
@@ -1450,46 +1717,87 @@ def _attn_paged_spec_kernel(
             gs = aligned + g * 8
             pg = bt_ref[b, gs // page]
             off = pl.multiple_of(gs - gs // page * page, 8)
-            rd_k = pltpu.make_async_copy(
-                kp_out.at[pg, :, pl.ds(off, 8), :],
-                kv_win.at[0, b, :, pl.ds(g * 8, 8), :], sem.at[0],
-            )
-            rd_v = pltpu.make_async_copy(
-                vp_out.at[pg, :, pl.ds(off, 8), :],
-                kv_win.at[1, b, :, pl.ds(g * 8, 8), :], sem.at[1],
-            )
-            rd_k.start()
-            rd_v.start()
-            reads += [rd_k, rd_v]
+            reads += [
+                pltpu.make_async_copy(
+                    kp_out.at[pg, :, pl.ds(off, 8), :],
+                    kv_win.at[0, b, :, pl.ds(g * 8, 8), :], sem.at[0],
+                ),
+                pltpu.make_async_copy(
+                    vp_out.at[pg, :, pl.ds(off, 8), :],
+                    kv_win.at[1, b, :, pl.ds(g * 8, 8), :], sem.at[1],
+                ),
+            ]
+            if kv_quant:
+                # Scale windows RMW in the same page-safe groups, on
+                # the same counting semaphores as the value reads.
+                reads += [
+                    pltpu.make_async_copy(
+                        ks_out.at[pg, :, pl.ds(off, 8)],
+                        s_win.at[0, b, :, pl.ds(g * 8, 8)], sem.at[0],
+                    ),
+                    pltpu.make_async_copy(
+                        vs_out.at[pg, :, pl.ds(off, 8)],
+                        s_win.at[1, b, :, pl.ds(g * 8, 8)], sem.at[1],
+                    ),
+                ]
+        for rd in reads:
+            rd.start()
         for rd in reads:
             rd.wait()
         offs = pos - aligned
         win_iota = jax.lax.broadcasted_iota(
             jnp.int32, (kv_heads, win, head_dim), 1
         )
-        for i in range(m):
-            sel = win_iota == offs + i
-            kv_win[0, b] = jnp.where(
-                sel, k_s[b, i][:, None, :].astype(kv_win.dtype), kv_win[0, b]
-            )
-            kv_win[1, b] = jnp.where(
-                sel, v_s[b, i][:, None, :].astype(kv_win.dtype), kv_win[1, b]
-            )
+        if kv_quant:
+            kq, ksc = kv_quant_rows(k_s[b])  # [m, KV, hd] int8, [m, KV]
+            vq, vsc = kv_quant_rows(v_s[b])
+            s_iota = jax.lax.broadcasted_iota(jnp.int32, (kv_heads, win), 1)
+            for i in range(m):
+                sel = win_iota == offs + i
+                kv_win[0, b] = jnp.where(sel, kq[i][:, None, :], kv_win[0, b])
+                kv_win[1, b] = jnp.where(sel, vq[i][:, None, :], kv_win[1, b])
+                s_sel = s_iota == offs + i
+                s_win[0, b] = jnp.where(s_sel, ksc[i][:, None], s_win[0, b])
+                s_win[1, b] = jnp.where(s_sel, vsc[i][:, None], s_win[1, b])
+        else:
+            for i in range(m):
+                sel = win_iota == offs + i
+                kv_win[0, b] = jnp.where(
+                    sel, k_s[b, i][:, None, :].astype(kv_win.dtype),
+                    kv_win[0, b]
+                )
+                kv_win[1, b] = jnp.where(
+                    sel, v_s[b, i][:, None, :].astype(kv_win.dtype),
+                    kv_win[1, b]
+                )
         for g in range(ngroups):
             gs = aligned + g * 8
             pg = bt_ref[b, gs // page]
             off = pl.multiple_of(gs - gs // page * page, 8)
-            wr_k = pltpu.make_async_copy(
-                kv_win.at[0, b, :, pl.ds(g * 8, 8), :],
-                kp_out.at[pg, :, pl.ds(off, 8), :], wsem.at[0, b, g],
-            )
-            wr_v = pltpu.make_async_copy(
-                kv_win.at[1, b, :, pl.ds(g * 8, 8), :],
-                vp_out.at[pg, :, pl.ds(off, 8), :], wsem.at[1, b, g],
-            )
-            wr_k.start()
-            wr_v.start()
-            pending += [wr_k, wr_v]
+            writes = [
+                pltpu.make_async_copy(
+                    kv_win.at[0, b, :, pl.ds(g * 8, 8), :],
+                    kp_out.at[pg, :, pl.ds(off, 8), :], wsem.at[0, b, g],
+                ),
+                pltpu.make_async_copy(
+                    kv_win.at[1, b, :, pl.ds(g * 8, 8), :],
+                    vp_out.at[pg, :, pl.ds(off, 8), :], wsem.at[1, b, g],
+                ),
+            ]
+            if kv_quant:
+                writes += [
+                    pltpu.make_async_copy(
+                        s_win.at[0, b, :, pl.ds(g * 8, 8)],
+                        ks_out.at[pg, :, pl.ds(off, 8)], wsem.at[2, b, g],
+                    ),
+                    pltpu.make_async_copy(
+                        s_win.at[1, b, :, pl.ds(g * 8, 8)],
+                        vs_out.at[pg, :, pl.ds(off, 8)], wsem.at[3, b, g],
+                    ),
+                ]
+            for wr in writes:
+                wr.start()
+            pending += writes
 
     # --- per-stream flash sweep + within-chunk causal fold ------------------
     attn_rows = []
@@ -1500,12 +1808,23 @@ def _attn_paged_spec_kernel(
         def body(blk, carry, pos=pos, b=b):
             m_run, l_run, acc = carry
             pg = bt_ref[b, blk]
-            kcp = pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2])
-            vcp = pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3])
-            kcp.start()
-            vcp.start()
-            kcp.wait()
-            vcp.wait()
+            copies = [
+                pltpu.make_async_copy(kp_out.at[pg], kblk, sem.at[2]),
+                pltpu.make_async_copy(vp_out.at[pg], vblk, sem.at[3]),
+            ]
+            if kv_quant:
+                copies += [
+                    pltpu.make_async_copy(
+                        ks_out.at[pg], sblk.at[0], sem.at[4]
+                    ),
+                    pltpu.make_async_copy(
+                        vs_out.at[pg], sblk.at[1], sem.at[5]
+                    ),
+                ]
+            for cp in copies:
+                cp.start()
+            for cp in copies:
+                cp.wait()
             live = (
                 jax.lax.broadcasted_iota(jnp.int32, (1, page), 1) + blk * page
             ) < pos
@@ -1514,8 +1833,12 @@ def _attn_paged_spec_kernel(
                 q_g = q_s[b, :, g * group : (g + 1) * group, :].reshape(
                     rows, head_dim
                 )
+                if kv_quant:
+                    k_g = kv_dequant(kblk[g], sblk[0, g], dtype)
+                else:
+                    k_g = kblk[g].astype(dtype)
                 s_g = jax.lax.dot_general(
-                    q_g.astype(dtype), kblk[g].astype(dtype),
+                    q_g.astype(dtype), k_g,
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 ) * scale  # [rows, page]
@@ -1527,10 +1850,14 @@ def _attn_paged_spec_kernel(
             l_new = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = []
             for g in range(kv_heads):
+                if kv_quant:
+                    v_g = kv_dequant(vblk[g], sblk[1, g], dtype)
+                else:
+                    v_g = vblk[g].astype(dtype)
                 pv.append(
                     jax.lax.dot(
                         p[g * rows : (g + 1) * rows].astype(dtype),
-                        vblk[g].astype(dtype),
+                        v_g,
                         preferred_element_type=jnp.float32,
                     )
                 )
@@ -1599,8 +1926,9 @@ def _attn_paged_spec_kernel(
 )
 def attention_paged_spec_step(
     x, norm_w, wqkv, sqkv, bqkv, cos_rows, sin_rows, k_pool, v_pool,
-    wo, swo, positions, block_tables, *, heads: int, kv_heads: int,
-    head_dim: int, m: int, eps: float = 1e-6, residual: bool = True,
+    wo, swo, positions, block_tables, k_scale=None, v_scale=None,
+    *, heads: int, kv_heads: int, head_dim: int, m: int,
+    eps: float = 1e-6, residual: bool = True,
 ):
     """Fused paged attention for B speculative-verify chunks.
 
@@ -1615,12 +1943,22 @@ def attention_paged_spec_step(
     ``positions[b] + m <= max_seq`` (the spec headroom contract, in the
     engine enforced by ``pages_needed``/``fits``). Returns
     (x_out [B*m, D], k_pool, v_pool).
+
+    ``k_scale``/``v_scale`` switch on the int8-KV path (see
+    :func:`attention_paged_batch_step`) and the return grows to
+    (x_out, k_pool, v_pool, k_scale, v_scale).
     """
+    kv_quant = k_scale is not None
+    assert kv_quant == (v_scale is not None)
     bm, d = x.shape
     assert bm % m == 0, (bm, m)
     batch = bm // m
     page = k_pool.shape[2]
     assert page % 8 == 0, page
+    if kv_quant:
+        assert k_pool.dtype == jnp.int8 and v_pool.dtype == jnp.int8, (
+            "int8-KV path needs int8 pools", k_pool.dtype
+        )
     seq = block_tables.shape[1] * page
     win = (7 + m + 7) // 8 * 8  # aligned row window covering all m rows
     assert win <= seq, (win, seq)
@@ -1628,8 +1966,37 @@ def attention_paged_spec_step(
     kernel = functools.partial(
         _attn_paged_spec_kernel, heads=heads, kv_heads=kv_heads,
         head_dim=head_dim, page=page, eps=eps, batch=batch, m=m, win=win,
-        seq=seq, residual=residual,
+        seq=seq, residual=residual, kv_quant=kv_quant,
     )
+    pool_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
+        pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+    ]
+    pool_outs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    pool_shapes = [
+        jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+        jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+    ]
+    scale_scratch = []
+    if kv_quant:
+        pool_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k_scale (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),  # v_scale (HBM)
+        ]
+        pool_outs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        pool_shapes += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        scale_scratch = [
+            pltpu.VMEM((2, batch, kv_heads, win), jnp.float32),  # s_win
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(1,),
@@ -1641,24 +2008,32 @@ def attention_paged_spec_step(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # bqkv
             pl.BlockSpec(memory_space=pltpu.VMEM),  # cos rows
             pl.BlockSpec(memory_space=pltpu.VMEM),  # sin rows
-            pl.BlockSpec(memory_space=pl.ANY),      # k_pool (HBM)
-            pl.BlockSpec(memory_space=pl.ANY),      # v_pool (HBM)
+            *pool_specs,
             pl.BlockSpec(memory_space=pltpu.VMEM),  # wo
             pl.BlockSpec(memory_space=pltpu.VMEM),  # swo
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            *pool_outs,
         ],
         scratch_shapes=[
             pltpu.VMEM((2, batch, kv_heads, win, head_dim), k_pool.dtype),
+            *scale_scratch,
             pltpu.VMEM((kv_heads, page, head_dim), k_pool.dtype),
             pltpu.VMEM((kv_heads, page, head_dim), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((4,)),
-            pltpu.SemaphoreType.DMA((2, batch, win // 8)),
+            *(
+                [pltpu.VMEM((2, kv_heads, page), jnp.float32)]  # sblk
+                if kv_quant else []
+            ),
+            pltpu.SemaphoreType.DMA((6 if kv_quant else 4,)),
+            pltpu.SemaphoreType.DMA(
+                (4 if kv_quant else 2, batch, win // 8)
+            ),
         ],
     )
+    operands = [k_pool, v_pool]
+    if kv_quant:
+        operands += [k_scale, v_scale]
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1666,10 +2041,11 @@ def attention_paged_spec_step(
             jax.ShapeDtypeStruct(
                 (bm, d), x.dtype if residual else jnp.float32
             ),
-            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
-            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            *pool_shapes,
         ],
-        input_output_aliases={9: 1, 10: 2},
+        input_output_aliases=(
+            {9: 1, 10: 2, 11: 3, 12: 4} if kv_quant else {9: 1, 10: 2}
+        ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
@@ -1678,7 +2054,7 @@ def attention_paged_spec_step(
         jnp.asarray(positions, jnp.int32).reshape(batch),
         jnp.asarray(block_tables, jnp.int32),
         x, norm_w.reshape(1, d), wqkv, sqkv, bqkv.reshape(1, n_qkv),
-        cos_rows, sin_rows, k_pool, v_pool, wo, swo,
+        cos_rows, sin_rows, *operands, wo, swo,
     )
 
 

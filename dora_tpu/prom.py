@@ -66,6 +66,9 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_serving_prefix_evictions_total": ("counter", "Cached prefix pages evicted under pool pressure"),
     "dora_serving_prefix_cached_pages": ("gauge", "KV pages held by the radix prefix cache"),
     "dora_serving_prefix_shared_pages": ("gauge", "Cached pages currently mapped shared into live streams"),
+    "dora_serving_kv_int8": ("gauge", "1 when the paged KV pool is int8 (quantized serving), 0 for fp"),
+    "dora_serving_kv_pool_bytes": ("gauge", "Total device bytes of the paged KV pool including scale planes"),
+    "dora_serving_kv_quant_err": ("gauge", "Mean relative quantization step over sampled allocated int8 KV pages (0 for fp pools)"),
     "dora_tpu_mfu": ("gauge", "Model FLOPs utilization: useful (emitted-token) FLOP/s over device peak"),
     "dora_tpu_device_busy_fraction": ("gauge", "Fraction of wall time the device spent computing dispatched windows"),
     "dora_tpu_device_hbm_used_bytes": ("gauge", "Device allocator bytes in use (0 when the backend exposes no memory stats)"),
@@ -119,6 +122,8 @@ _SERVING_GAUGES = (
     ("hbm_used_bytes", "dora_tpu_device_hbm_used_bytes"),
     ("hbm_limit_bytes", "dora_tpu_device_hbm_limit_bytes"),
     ("hbm_peak_bytes", "dora_tpu_device_hbm_peak_bytes"),
+    ("kv_pool_bytes", "dora_serving_kv_pool_bytes"),
+    ("kv_quant_err", "dora_serving_kv_quant_err"),
 )
 
 
@@ -162,6 +167,12 @@ def iter_samples(
                 yield family, labels, s.get(key, 0) or 0
             for key, family in _SERVING_GAUGES:
                 yield family, labels, s.get(key, 0) or 0
+            # kv_dtype is a string in the snapshot; prom values are
+            # numeric, so it exports as a 0/1 int8 flag.
+            yield (
+                "dora_serving_kv_int8", labels,
+                1 if s.get("kv_dtype") == "int8" else 0,
+            )
             for cls, depth in (s.get("qos_depth") or {}).items():
                 yield (
                     "dora_serving_qos_depth",
@@ -373,6 +384,9 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "hbm_used_bytes": 12 << 30,
                     "hbm_limit_bytes": 16 << 30,
                     "hbm_peak_bytes": 13 << 30,
+                    "kv_dtype": "int8",
+                    "kv_pool_bytes": 2 << 30,
+                    "kv_quant_err": 0.004,
                     "qos_depth": {"interactive": 0, "standard": 1, "batch": 3},
                     "ttft_us": hist.snapshot(),
                 }

@@ -51,6 +51,10 @@ WATCHED: dict[str, str] = {
     # off — a drift upward means the default-on monitor got expensive
     # (the gate is <= 3%).
     "serving_profiling_ab.overhead_pct": "lower",
+    # Quantized serving: concurrent streams admitted into the fp
+    # pool's byte budget, int8 vs fp — a drift downward means the
+    # scale-plane overhead grew (the gate is >= 1.8).
+    "serving_quant_ab.capacity.int8_capacity_ratio": "higher",
 }
 
 #: flag when a watched metric is worse than the previous run by more
