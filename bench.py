@@ -812,6 +812,42 @@ def serving_quant_ab() -> dict:
     return data
 
 
+def serving_lora_ab() -> dict:
+    """Multi-tenant LoRA A/B (tools/bench_serving --lora-ab): aggregate
+    tok/s of one paged engine serving N adapter tenants vs N separate
+    engines splitting the same HBM budget, plus the adapter-churn leg
+    counting steady-state compiles. Headline: ``lora_aggregate_ratio``
+    >= 1.5 and ``churn.steady_state_compiles`` == 0. Fresh subprocess
+    for the same accelerator-claim reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--lora-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "lora_ab" in row:
+            data = row["lora_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "shared": None,
+            "separate": None,
+            "lora_aggregate_ratio": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_fps() -> dict:
     """North-star axis: camera -> VLM-2B -> sink FPS through the daemon.
 
@@ -1053,6 +1089,16 @@ def main() -> int:
         }
 
     try:
+        lora_ab = serving_lora_ab()
+    except Exception as exc:
+        lora_ab = {
+            "shared": None,
+            "separate": None,
+            "lora_aggregate_ratio": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         e2e = serving_fps()
     except Exception as exc:  # serving bench must never sink the headline
         e2e = {"fps": None, "note": f"serving bench failed: {exc!r}"}
@@ -1094,6 +1140,7 @@ def main() -> int:
         "serving_qos_soak": qos_soak,
         "serving_prefix_ab": prefix_ab,
         "serving_quant_ab": quant_ab,
+        "serving_lora_ab": lora_ab,
         "e2e_fps": None if e2e["fps"] is None else round(e2e["fps"], 1),
         "e2e_vs_north_star": (
             None if e2e["fps"] is None else round(e2e["fps"] / 25.0, 2)

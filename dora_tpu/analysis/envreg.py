@@ -130,6 +130,10 @@ REGISTRY: dict[str, EnvVar] = dict((
     _e("DORA_KV_INT8", "bool", "0", "int8 KV pages with per-page scales",
        True),
     _e("DORA_WEIGHT_BITS", "str", "", "decode weight bits (4 or 8)", True),
+    _e("DORA_LORA_DIR", "path", "", "LoRA adapter catalog directory", True),
+    _e("DORA_LORA_MAX_RESIDENT", "int", "8",
+       "resident LoRA adapter slots", True),
+    _e("DORA_LORA_RANK", "int", "", "LoRA pool rank override", True),
     _e("DORA_PARAM_DTYPE", "str", "", "parameter dtype override"),
     _e("DORA_SP_IMPL", "str", "", "sequence-parallel impl selector", True),
     _e("DORA_SPEC_DECODE", "bool", "0", "speculative decoding", True),

@@ -25,6 +25,8 @@ deploy-time diagnostics with machine-readable codes:
 * ``graph-slo-non-serving`` — ``slo:`` serving targets (ttft,
   tokens/s) on a node that reports no serving metrics; the burn-rate
   gauges would read forever-zero and the SLO silently never fires.
+  An explicit ``serving: true``/``false`` node flag overrides the
+  source-name heuristic for this and the qos check.
 * ``graph-qos-non-serving`` — ``qos:`` on a node with no admission
   queue to shape.
 * ``graph-qos-deadline-quantum`` — ``shed_wait_ms`` below the fused
@@ -65,6 +67,12 @@ _MS_PER_STEP_FLOOR = 1.0
 
 
 def _is_serving(node) -> bool:
+    # An explicit ``serving:`` declaration in the descriptor wins over
+    # the source-name heuristic — a custom serving node under any
+    # source name can opt in (and a node whose source merely mentions a
+    # serving module can opt out with ``serving: false``).
+    if getattr(node, "serving", None) is not None:
+        return bool(node.serving)
     kind = node.kind
     return isinstance(kind, CustomNode) and any(
         s in str(kind.source) for s in SERVING_SOURCES

@@ -290,6 +290,36 @@ def render_metrics(
                 prefix_rows,
             )
 
+    # Multi-tenant LoRA plane: resident-adapter pool occupancy, churn
+    # (loads/evictions), adapter HBM bytes, and per-tenant live-stream
+    # pins. Only appears once an engine actually serves adapters —
+    # single-tenant deployments and old snapshots stay clean.
+    if serving:
+        tenant_rows = []
+        for nid in sorted(serving):
+            s = serving[nid]
+            streams = s.get("adapter_streams") or {}
+            if not s.get("lora_max_resident") and not streams:
+                continue
+            pinned = ", ".join(
+                f"{name}:{n}" for name, n in sorted(streams.items())
+            )
+            tenant_rows.append([
+                nid,
+                f"{s.get('lora_resident', 0)}"
+                f"/{s.get('lora_max_resident', 0)}",
+                _fmt_bytes(s.get("lora_resident_bytes", 0)),
+                str(s.get("lora_loads", 0)),
+                str(s.get("lora_evictions", 0)),
+                pinned or "-",
+            ])
+        if tenant_rows:
+            lines += [""] + _table(
+                ["TENANT", "RESIDENT", "BYTES", "LOADS", "EVICT",
+                 "STREAMS"],
+                tenant_rows,
+            )
+
     # Device utilization plane (round 16): MFU / busy fraction / HBM
     # gauges plus the cumulative window-time attribution. The table
     # appears once any node ships device keys; individual unknown

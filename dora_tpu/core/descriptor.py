@@ -407,6 +407,11 @@ class ResolvedNode:
     restart: RestartPolicy | None = None
     slo: SloPolicy | None = None
     qos: QosPolicy | None = None
+    #: Explicit serving-engine declaration (``serving: true`` in YAML).
+    #: ``slo:``/``qos:`` validation trusts this over the source-name
+    #: heuristic, so custom serving nodes under any source name pass.
+    #: None = undeclared (heuristic applies); False = declared non-serving.
+    serving: bool | None = None
 
     @property
     def inputs(self) -> dict[DataId, Input]:
@@ -589,6 +594,12 @@ class Descriptor:
         deploy = Deploy.parse(value.get("deploy") or value.get("_unstable_deploy"))
         if deploy.machine is None and default_deploy is not None:
             deploy = default_deploy
+        serving = value.get("serving")
+        if serving is not None and not isinstance(serving, bool):
+            raise ValueError(
+                f"node {node_id!r}: 'serving' must be a boolean, got "
+                f"{serving!r}"
+            )
         return ResolvedNode(
             id=node_id,
             name=value.get("name"),
@@ -599,6 +610,7 @@ class Descriptor:
             restart=RestartPolicy.parse(value.get("restart")),
             slo=SloPolicy.parse(value.get("slo")),
             qos=QosPolicy.parse(value.get("qos")),
+            serving=serving,
         )
 
     # -- queries ------------------------------------------------------------

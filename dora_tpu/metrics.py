@@ -193,6 +193,8 @@ class ServingMetrics:
         "hbm_used_bytes", "hbm_limit_bytes", "hbm_peak_bytes",
         "mfu", "device_busy_fraction",
         "kv_dtype", "kv_pool_bytes", "kv_quant_err",
+        "lora_resident", "lora_max_resident", "lora_resident_bytes",
+        "lora_loads", "lora_evictions", "adapter_streams",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -317,6 +319,19 @@ class ServingMetrics:
         self.kv_dtype = "fp"
         self.kv_pool_bytes: int | None = None
         self.kv_quant_err: float | None = None
+        #: multi-tenant LoRA plane (paged engine, DORA_LORA_DIR):
+        #: resident adapters vs pool capacity, their HBM bytes, and the
+        #: cumulative load/eviction churn (a high eviction rate against
+        #: a small resident pool is the swap-thrash signature — see
+        #: KNOWN_ISSUES round 19). ``adapter_streams`` is a dict gauge:
+        #: live streams pinned per resident adapter (tenant name keys,
+        #: the qos_depth idiom).
+        self.lora_resident = 0
+        self.lora_max_resident = 0
+        self.lora_resident_bytes = 0
+        self.lora_loads = 0
+        self.lora_evictions = 0
+        self.adapter_streams: dict[str, int] = {}
 
     def snapshot(self) -> dict:
         import time
@@ -402,6 +417,12 @@ class ServingMetrics:
             "kv_dtype": self.kv_dtype,
             "kv_pool_bytes": self.kv_pool_bytes,
             "kv_quant_err": self.kv_quant_err,
+            "lora_resident": self.lora_resident,
+            "lora_max_resident": self.lora_max_resident,
+            "lora_resident_bytes": self.lora_resident_bytes,
+            "lora_loads": self.lora_loads,
+            "lora_evictions": self.lora_evictions,
+            "adapter_streams": dict(self.adapter_streams),
         }
 
 

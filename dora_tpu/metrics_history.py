@@ -112,12 +112,13 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
                      "prefix_cow_copies", "prefix_evictions",
                      "device_compute_ns", "host_dispatch_ns",
                      "device_fetch_ns", "dispatched_flops",
-                     "useful_flops"):
+                     "useful_flops", "lora_loads", "lora_evictions"):
             counters[f"srv:{node}:{name}"] = s.get(name, 0)
         for name in ("slots_active", "slots_total", "used_pages",
                      "total_pages", "free_pages", "backlog_depth",
                      "autotune_k", "prefix_cached_pages",
-                     "prefix_shared_pages"):
+                     "prefix_shared_pages", "lora_resident",
+                     "lora_max_resident", "lora_resident_bytes"):
             gauges[f"srv:{node}:{name}"] = s.get(name, 0)
         # Device utilization gauges are None when unknown (CPU backend,
         # monitor off, pre-round-16 snapshot): recorded only when real,
@@ -135,6 +136,8 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
             )
         for cls, d in (s.get("qos_depth") or {}).items():
             gauges[f"srv:{node}:qos_depth:{cls}"] = d
+        for name, n in (s.get("adapter_streams") or {}).items():
+            gauges[f"srv:{node}:adapter_streams:{name}"] = n
         ttft = s.get("ttft_us") or {}
         hists[f"srv:{node}:ttft_us"] = list(ttft.get("counts", []))
     return counters, gauges, hists

@@ -432,6 +432,12 @@ class _PagedSlot:
     #: leading pages of ``pages`` mapped SHARED from the prefix cache
     #: (refcounted, immutable); the stream's own writes start past them
     shared: int = 0
+    #: LoRA adapter identity (stable tenant NAME; None = base model)
+    #: plus its resident stack slot index — the index is pinned for the
+    #: stream's lifetime (AdapterPool refcount custody), so it rides
+    #: the traced adapter-id vector unchanged between rebuilds.
+    adapter: str | None = None
+    adapter_idx: int = 0
 
 
 class PagedBatchEngine:
@@ -494,7 +500,7 @@ class PagedBatchEngine:
                  chunk: int, num_pages: int, eos: int | None = None,
                  window: int = 8, spec_k: int = 0, spec_ngram: int = 2,
                  window_factory=None, prefix_cache: bool = False,
-                 prefix_cache_pages: int = 0):
+                 prefix_cache_pages: int = 0, lora_pool=None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -553,6 +559,14 @@ class PagedBatchEngine:
         self._emitted_dev = jnp.zeros((max_slots,), jnp.int32)
         self._maxnew_dev = jnp.zeros((max_slots,), jnp.int32)
         self._members_dirty = True
+        #: multi-tenant LoRA serving (models/lora_pool.AdapterPool):
+        #: when attached, every window/chunk dispatch carries a per-row
+        #: adapter slot-id vector plus the resident adapter stack as
+        #: traced operands — mixed-tenant batches share ONE window
+        #: program and adapter churn never recompiles. None = the exact
+        #: pre-LoRA engine (window signatures unchanged).
+        self.lora = lora_pool
+        self._adapter_dev = jnp.zeros((max_slots,), jnp.int32)
         #: prompt-lookup speculation (0 = off = the exact pre-spec
         #: program). With spec_k > 0 the window is the
         #: make_paged_spec_window variant and carries two extra device
@@ -669,10 +683,17 @@ class PagedBatchEngine:
         pages for when speculation resumes."""
         return self._spec_cfg + 1 if self._spec_cfg else 0
 
-    def fits(self, prompt_len: int, max_new: int) -> bool:
-        """Admissible EVER: length fits the block table and the whole
+    def fits(self, prompt_len: int, max_new: int,
+             adapter: str | None = None) -> bool:
+        """Admissible EVER: length fits the block table, the whole
         pool could grant its pages (a request that can never fit must
-        be rejected up front, not parked in a backlog forever)."""
+        be rejected up front, not parked in a backlog forever), and —
+        multi-tenant serving — the named adapter is one this engine
+        can make resident (residency bytes are the adapter pool's
+        fixed stack; what varies is whether the tenant is servable at
+        all)."""
+        if adapter and (self.lora is None or not self.lora.has(adapter)):
+            return False
         return (
             prompt_len + max_new + self.spec_headroom() <= self.max_seq
             and self.pages_needed(prompt_len, max_new)
@@ -693,35 +714,54 @@ class PagedBatchEngine:
         rows = max(chunk_rows, prompt_len + max_new + self.spec_headroom())
         return -(-rows // self.page_size)
 
-    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+    def can_admit(self, prompt_len: int, max_new: int,
+                  adapter: str | None = None) -> bool:
         avail = self.free_pages
         if self.prefix_cache is not None:
             # Eviction yields to admission: unpinned, unshared cached
             # pages are free-in-waiting, never a reason to shed.
             avail += self.prefix_cache.evictable_pages()
+        if adapter and (self.lora is None or not self.lora.fits(adapter)):
+            # Adapter residency is admission state like pages: every
+            # resident slot pinned by live streams means this tenant
+            # must wait for a release, exactly like a full page pool.
+            return False
         return (
             self.free_slots > 0
-            and self.fits(prompt_len, max_new)
+            and self.fits(prompt_len, max_new, adapter)
             and self.pages_needed(prompt_len, max_new) <= avail
         )
 
-    def submit(self, request_id: str, prompt_ids, max_new: int) -> None:
+    def submit(self, request_id: str, prompt_ids, max_new: int,
+               adapter: str | None = None) -> None:
         """Admit a stream: grant its pages, write its block table and
         queue its prefill. Returns None — the first token is emitted by
         a later :meth:`step` (prefill is chunked and interleaved, not
-        synchronous), unlike the dense engine's submit."""
+        synchronous), unlike the dense engine's submit. ``adapter``
+        names the stream's LoRA tenant (None = base model); admission
+        pins it resident for the stream's lifetime."""
         ids = [int(t) for t in prompt_ids]
-        if not self.can_admit(len(ids), max_new):
+        if not self.can_admit(len(ids), max_new, adapter):
             raise RuntimeError(
                 f"cannot admit: {self.free_slots} slots, "
                 f"{self.free_pages} pages free vs "
                 f"{self.pages_needed(len(ids), max_new)} needed "
-                f"({len(ids)}+{max_new}, max_seq {self.max_seq})"
+                f"({len(ids)}+{max_new}, max_seq {self.max_seq}"
+                + (f", adapter {adapter!r}" if adapter else "")
+                + ")"
             )
+        aidx = 0
+        if adapter:
+            aidx = self.lora.acquire(adapter)
+            if aidx is None:
+                raise RuntimeError(
+                    f"cannot admit {request_id!r}: adapter pool full "
+                    f"of pinned adapters ({adapter!r} not resident)"
+                )
         b = self.slots.index(None)
         base0, shared = (0, [])
         if self.prefix_cache is not None:
-            base0, shared = self._prefix_grant(ids, max_new)
+            base0, shared = self._prefix_grant(ids, max_new, adapter)
         need = self.pages_needed(len(ids), max_new, base0) - len(shared)
         if need > self.allocator.free_pages and self.prefix_cache is not None:
             self.prefix_cache.evict(need - self.allocator.free_pages)
@@ -729,6 +769,8 @@ class PagedBatchEngine:
         if fresh is None:
             if shared:
                 self.allocator.unref(shared)
+            if adapter:
+                self.lora.release(adapter)
             raise RuntimeError(
                 f"cannot admit {request_id!r}: page pool exhausted "
                 f"({need} fresh needed, {self.free_pages} free)"
@@ -739,7 +781,7 @@ class PagedBatchEngine:
         self.slots[b] = _PagedSlot(
             request_id, emitted=0, max_new=max_new, pages=pages,
             prompt=ids, true_len=len(ids), chunk_base=base0,
-            shared=len(shared),
+            shared=len(shared), adapter=adapter, adapter_idx=aidx,
         )
         self._decode[b] = False
         self._prefillq.append(b)
@@ -762,8 +804,8 @@ class PagedBatchEngine:
             )
         return None
 
-    def _prefix_grant(self, ids: list[int], max_new: int
-                      ) -> tuple[int, list[int]]:
+    def _prefix_grant(self, ids: list[int], max_new: int,
+                      adapter: str | None = None) -> tuple[int, list[int]]:
         """Longest usable cached prefix for a new prompt: looks up the
         radix cache, trims the match so (a) at least the final prompt
         token is re-prefilled (the first generated token comes off the
@@ -779,7 +821,10 @@ class PagedBatchEngine:
         pages are never written in place)."""
         ps = self.page_size
         cache = self.prefix_cache
-        matched, pages, mid_page = cache.lookup(ids)
+        # Tenancy: the lookup walks the stream's OWN adapter tree
+        # (prefix_cache keys on (adapter, tokens)), so two tenants with
+        # identical prompts can never map each other's KV.
+        matched, pages, mid_page = cache.lookup(ids, adapter)
         cap = (len(ids) - 1) // ps * ps
         lo = min(matched, cap)
         while lo and (
@@ -821,6 +866,10 @@ class PagedBatchEngine:
         # cache / other streams — the page pool reclaims each page only
         # when its last holder lets go.
         self.allocator.unref(self.slots[b].pages)
+        if self.lora is not None and self.slots[b].adapter:
+            # Drop the stream's residency pin; the adapter STAYS warm
+            # until eviction needs its slot (prefix-cache discipline).
+            self.lora.release(self.slots[b].adapter)
         self._bt[b, :] = 0
         self.slots[b] = None
         self._decode[b] = False
@@ -837,17 +886,17 @@ class PagedBatchEngine:
         tables (the prefix cache's own holdings are cached_pages)."""
         return sum(s.shared for s in self.slots if s is not None)
 
-    def prefix_pin(self, ids) -> int:
+    def prefix_pin(self, ids, adapter: str | None = None) -> int:
         """Pin the cached path for ``ids`` against eviction (a
         preempted victim's prefix survives the wait to resume on
         refcount custody, not slot custody). No-op without a cache."""
         if self.prefix_cache is None:
             return 0
-        return self.prefix_cache.pin(ids)
+        return self.prefix_cache.pin(ids, adapter)
 
-    def prefix_unpin(self, ids) -> None:
+    def prefix_unpin(self, ids, adapter: str | None = None) -> None:
         if self.prefix_cache is not None:
-            self.prefix_cache.unpin(ids)
+            self.prefix_cache.unpin(ids, adapter)
 
     def check_invariants(self) -> None:
         """Allocator bookkeeping plus cross-custody: every allocated
@@ -906,6 +955,7 @@ class PagedBatchEngine:
             "max_new": s.max_new,
             "pages": len(s.pages),
             "was_decoding": bool(self._decode[b]),
+            "adapter": s.adapter,
         }
         self._free_slot(b)
         if self.serving_metrics is not None:
@@ -974,10 +1024,22 @@ class PagedBatchEngine:
             base = s.chunk_base
             piece = s.prompt[base : base + self.chunk]
             piece = piece + [0] * (self.chunk - len(piece))
-            greedy, self.pools = self.chunk_prefill(
-                jnp.asarray(piece, jnp.int32), self.pools,
-                jnp.asarray(base, jnp.int32), jnp.asarray(self._bt[b]),
-            )
+            if self.lora is not None:
+                # Adapter id rides as a traced operand (an int32 device
+                # scalar, never a python constant) so chunk prefill
+                # keeps its one-compiled-shape discipline across
+                # tenants.
+                greedy, self.pools = self.chunk_prefill(
+                    jnp.asarray(piece, jnp.int32), self.pools,
+                    jnp.asarray(base, jnp.int32), jnp.asarray(self._bt[b]),
+                    jnp.asarray(s.adapter_idx, jnp.int32),
+                    self.lora.state(),
+                )
+            else:
+                greedy, self.pools = self.chunk_prefill(
+                    jnp.asarray(piece, jnp.int32), self.pools,
+                    jnp.asarray(base, jnp.int32), jnp.asarray(self._bt[b]),
+                )
             t_disp = time.perf_counter()
             s.chunk_base = base + self.chunk
             self.chunks_run += 1
@@ -1008,6 +1070,7 @@ class PagedBatchEngine:
                         self.prefix_cache.insert(
                             s.prompt[: n_full * self.page_size],
                             s.pages[:n_full],
+                            s.adapter,
                         )
                 s.prompt = None
                 # Host-index AFTER a full [C] fetch — a device gather at
@@ -1091,6 +1154,20 @@ class PagedBatchEngine:
                     ],
                     jnp.int32,
                 )
+                if self.lora is not None:
+                    # Per-row adapter slot ids — rebuilt ONLY here, at
+                    # membership changes: a stream's resident index is
+                    # refcount-pinned for its whole life, so between
+                    # boundaries the vector cannot go stale.
+                    self._adapter_dev = jnp.asarray(
+                        [
+                            s.adapter_idx
+                            if s is not None and self._decode[i]
+                            else 0
+                            for i, s in enumerate(self.slots)
+                        ],
+                        jnp.int32,
+                    )
                 if self.spec_k:
                     # History only needs rebuilding when membership
                     # changes too: between boundaries the device carries
@@ -1115,6 +1192,15 @@ class PagedBatchEngine:
                 )
                 self._bt_dirty = False
             t_win = time.perf_counter()
+            #: multi-tenant serving: adapter ids + the resident stack
+            #: ride every dispatch as trailing traced operands (fixed
+            #: shapes — churn rewrites stack contents, never the
+            #: program).
+            extra = (
+                (self._adapter_dev, self.lora.state())
+                if self.lora is not None
+                else ()
+            )
             if self.spec_k:
                 (
                     mat,
@@ -1128,7 +1214,7 @@ class PagedBatchEngine:
                 ) = self.window_step(
                     self.tokens, self.pools, self.positions, self._bt_dec,
                     self._mask, self._emitted_dev, self._maxnew_dev,
-                    self._hist_dev, self._histlen_dev,
+                    self._hist_dev, self._histlen_dev, *extra,
                 )
             else:
                 (
@@ -1141,6 +1227,7 @@ class PagedBatchEngine:
                 ) = self.window_step(
                     self.tokens, self.pools, self.positions, self._bt_dec,
                     self._mask, self._emitted_dev, self._maxnew_dev,
+                    *extra,
                 )
             self.dispatches += 1
             t_fetch = time.perf_counter()
@@ -1317,6 +1404,12 @@ class PagedBatchEngine:
                 "last_token": int(toks[b]),
                 "position": int(pos[b]),
             }
+            if s.adapter:
+                # Stable tenant NAME, never the resident slot index —
+                # indices are recycled by eviction and mean nothing to
+                # another engine. Absent for base streams, so pre-LoRA
+                # snapshots and LoRA-era base snapshots are one format.
+                meta["adapter"] = s.adapter
             if self._spec_cfg:
                 # Draft-lookup history (prompt + emissions). Output
                 # identity does NOT depend on it — verification makes
@@ -1365,9 +1458,31 @@ class PagedBatchEngine:
         # a prefill re-submit must not claim it out from under them.
         for meta in sorted(metas, key=lambda m: not m.get("decode")):
             if not meta.get("decode"):
-                self.submit(meta["request_id"], meta["prompt"], meta["max_new"])
+                self.submit(
+                    meta["request_id"],
+                    meta["prompt"],
+                    meta["max_new"],
+                    adapter=meta.get("adapter"),
+                )
                 restored.append(meta["request_id"])
                 continue
+            # Adapter custody rides the stream: re-pin it resident
+            # before the slot exists, so the first window already
+            # gathers the right slab. A snapshot without "adapter"
+            # (pre-LoRA, or a base stream) resolves to slot 0.
+            adapter = meta.get("adapter")
+            if adapter and self.lora is None:
+                raise RuntimeError(
+                    f"cannot restore stream {meta['request_id']!r}: "
+                    f"snapshot names adapter {adapter!r} but this "
+                    f"engine has no adapter pool"
+                )
+            aidx = self.lora.acquire(adapter) if self.lora is not None else 0
+            if aidx is None:
+                raise RuntimeError(
+                    f"cannot restore stream {meta['request_id']!r}: "
+                    f"adapter {adapter!r} cannot be made resident"
+                )
             n_pages = len(meta["pages"])
             if pin_slots:
                 b = meta["slot"]
@@ -1407,6 +1522,8 @@ class PagedBatchEngine:
                 # Migrate-in re-grants fresh pages, so sharing does not
                 # survive the hop (pool contents are not shipped either).
                 shared=meta.get("shared", 0) if pin_slots else 0,
+                adapter=adapter,
+                adapter_idx=aidx,
             )
             self._decode[b] = True
             if self._spec_cfg:
@@ -1511,7 +1628,8 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
                            prefix_cache_pages: int = 0,
                            chunk_sleep_s: float = 0.0,
                            flops_per_token: int = 1_000_000,
-                           peak_flops: float = 1e12):
+                           peak_flops: float = 1e12,
+                           lora_max_resident: int = 0):
     """A weight-free :class:`PagedBatchEngine` over the REAL window
     machinery: the decode window is ``vlm.make_paged_window`` (the same
     ``lax.scan`` + ``freeze_inactive`` program serving runs) with the
@@ -1540,7 +1658,17 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
     ~100% after one period — while the affine rule (period ~vocab)
     keeps acceptance near zero. Together they drive both the
     draft-accept and draft-reject paths engine-free (the
-    ``DORA_STUB_ENGINE=1`` A/B legs of bench_serving --spec-ab)."""
+    ``DORA_STUB_ENGINE=1`` A/B legs of bench_serving --spec-ab).
+
+    ``lora_max_resident > 0`` attaches an :class:`AdapterPool` whose
+    stub "adapter" is a scalar int32 SHIFT derived from the tenant
+    name, and the rule becomes ``(rule(t) + shift[g]) % vocab`` — slot
+    0's zero shift keeps base streams identical to the lora-off stub,
+    while each tenant's stream is a distinct deterministic sequence.
+    That is exactly the multi-tenant identity contract (per-tenant
+    streams must match a single-tenant engine token for token) with
+    adapter math cheap enough for tier-1, and the bench_serving
+    --lora-ab legs drive churn/eviction through it engine-free."""
     import jax
     import jax.numpy as jnp
 
@@ -1556,23 +1684,55 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         def rule(t):
             return (t + 1) % cycle
 
-    def step_fn(tokens, pools, positions, bts):
-        del positions, bts
-        return rule(tokens), pools
+    lora_pool = None
+    if lora_max_resident:
+        from dora_tpu.models.lora_pool import AdapterPool
 
-    def spec_step_fn(chunks, pools, positions, bts):
-        del positions, bts
-        return rule(chunks), pools
+        def stub_loader(name):
+            # Deterministic, engine-free: the tenant name IS the
+            # adapter (a nonzero shift), so A/B legs need no weight
+            # files and restores on a fresh process resolve the same
+            # shift from the same name.
+            return jnp.asarray(
+                (sum(ord(c) for c in name) * 131 + 17) % vocab, jnp.int32
+            )
+
+        lora_pool = AdapterPool(
+            stub_loader,
+            jnp.asarray(0, jnp.int32),
+            max_resident=lora_max_resident,
+        )
+
+        def step_fn(tokens, pools, positions, bts, adapters, shifts):
+            del positions, bts
+            return (rule(tokens) + shifts[adapters]) % vocab, pools
+
+        def spec_step_fn(chunks, pools, positions, bts, adapters, shifts):
+            del positions, bts
+            return (rule(chunks) + shifts[adapters][:, None]) % vocab, pools
+    else:
+        def step_fn(tokens, pools, positions, bts):
+            del positions, bts
+            return rule(tokens), pools
+
+        def spec_step_fn(chunks, pools, positions, bts):
+            del positions, bts
+            return rule(chunks), pools
 
     def window_factory(k, sk):
         if sk:
             base = jax.jit(
                 make_paged_spec_window(
                     spec_step_fn, k=k, spec_k=sk, ngram=spec_ngram, eos=eos,
+                    lora=lora_pool is not None,
                 )
             )
         else:
-            base = jax.jit(make_paged_window(step_fn, k=k, eos=eos))
+            base = jax.jit(
+                make_paged_window(
+                    step_fn, k=k, eos=eos, lora=lora_pool is not None,
+                )
+            )
 
         def window_step(*args):
             out = base(*args)
@@ -1583,19 +1743,27 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
 
         return window_step
 
-    chunk_jit = jax.jit(
-        lambda ids, pools, position, bt: (rule(ids), pools),
-        # Same donation contract as the real chunk fns (hf/qwen2.py):
-        # the engine replaces its pools reference with the return value,
-        # so the stale buffer must not stay alive.
-        donate_argnums=(1,),
-    )
+    if lora_pool is not None:
+        chunk_jit = jax.jit(
+            lambda ids, pools, position, bt, adapter, shifts: (
+                (rule(ids) + shifts[adapter]) % vocab, pools
+            ),
+            donate_argnums=(1,),
+        )
+    else:
+        chunk_jit = jax.jit(
+            lambda ids, pools, position, bt: (rule(ids), pools),
+            # Same donation contract as the real chunk fns (hf/qwen2.py):
+            # the engine replaces its pools reference with the return value,
+            # so the stale buffer must not stay alive.
+            donate_argnums=(1,),
+        )
     if chunk_sleep_s:
         # Emulate per-chunk device cost (the prefix-cache A/B bench
         # needs prefills that measurably take chunk-count time, same
         # idea as tick_sleep_s for windows).
-        def chunk_fn(ids, pools, position, bt):
-            out = chunk_jit(ids, pools, position, bt)
+        def chunk_fn(*args):
+            out = chunk_jit(*args)
             time.sleep(chunk_sleep_s)
             return out
     else:
@@ -1617,6 +1785,7 @@ def make_stub_paged_engine(*, max_slots: int = 4, max_seq: int = 64,
         spec_ngram=spec_ngram,
         prefix_cache=prefix_cache,
         prefix_cache_pages=prefix_cache_pages,
+        lora_pool=lora_pool,
     )
     # Synthetic FLOPs constants so the utilization plane (MFU gauges,
     # attribution spans, UTIL panels) is exercised end-to-end by tier-1

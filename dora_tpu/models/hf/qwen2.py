@@ -253,12 +253,16 @@ def make_batch_engine(params, cfg: Qwen2Config, *, max_slots: int = 4,
 
 
 def fused_paged_batch_step(params, cfg, tokens, pools, positions,
-                           block_tables):
+                           block_tables, lora=None):
     """One fused decode step for B independent sequences over PAGED KV
     pools. tokens/positions: [B] int32; block_tables: [B, max_pages]
     int32 (0 = the reserved null page); pools: {layer: {k/v:
     [P, KV, page, hd]}}. Returns (greedy [B], pools). The paged
-    engine's inner step (models/batch_engine.PagedBatchEngine)."""
+    engine's inner step (models/batch_engine.PagedBatchEngine).
+    ``lora`` is ``(groups [B], a_stack [S, L, dim, r],
+    b_stack [S, L, r, dim])`` — per-row adapter deltas gathered by the
+    grouped Pallas matmul inside the fused pass (ops/lora.py); None is
+    the adapter-free program, byte-identical to before."""
     from dora_tpu.models import vlm as _vlm
     from dora_tpu.ops import decode_block as DB
 
@@ -270,12 +274,12 @@ def fused_paged_batch_step(params, cfg, tokens, pools, positions,
     return _vlm.fused_paged_pass_batch(
         params, x, pools, positions, block_tables, cos_rows, sin_rows,
         heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
-        layers=cfg.layers, eps=cfg.norm_eps,
+        layers=cfg.layers, eps=cfg.norm_eps, lora=lora,
     )
 
 
 def fused_paged_spec_step(params, cfg, chunks, pools, positions,
-                          block_tables):
+                          block_tables, lora=None):
     """Speculative VERIFICATION pass for B independent streams over
     PAGED KV pools: chunks [B, m] holds each stream's (last token +
     m-1 drafts) at positions ``positions[b]..positions[b]+m-1``;
@@ -294,16 +298,21 @@ def fused_paged_spec_step(params, cfg, chunks, pools, positions,
     flat_pos = (positions[:, None] + jnp.arange(m)[None, :]).reshape(b * m)
     cos_rows, sin_rows = DB.rope_rows_at(cos_t, sin_t, flat_pos)
     x = params["embed"].astype(dtype)[chunks.reshape(b * m)]  # [B*m, dim]
+    if lora is not None:
+        # The pass sees B*m flattened rows; every candidate row of a
+        # stream gathers that stream's adapter.
+        groups, a_stack, b_stack = lora
+        lora = (jnp.repeat(groups, m), a_stack, b_stack)
     greedy, pools = _vlm.fused_paged_pass_spec(
         params, x, pools, positions, block_tables, cos_rows, sin_rows,
         heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
-        layers=cfg.layers, m=m, eps=cfg.norm_eps,
+        layers=cfg.layers, m=m, eps=cfg.norm_eps, lora=lora,
     )
     return greedy.reshape(b, m), pools
 
 
 def fused_paged_chunk_step(params, cfg, chunk_ids, pools, position,
-                           block_table):
+                           block_table, lora=None):
     """One prefill chunk into paged pools: chunk_ids [C] int32 at
     positions ``position..position+C-1`` (both page-multiples; the tail
     chunk is right-padded — pad rows land beyond ``true_len`` and are
@@ -320,10 +329,14 @@ def fused_paged_chunk_step(params, cfg, chunk_ids, pools, position,
                                 base=cfg.rope_theta)
     cos_rows, sin_rows = DB.rope_rows(cos_t, sin_t, position, c)
     x = params["embed"].astype(dtype)[chunk_ids]  # [C, dim]
+    if lora is not None:
+        # One prompt per chunk call: every row is the same tenant.
+        adapter, a_stack, b_stack = lora
+        lora = (jnp.full((c,), 0, jnp.int32) + adapter, a_stack, b_stack)
     return _vlm.fused_paged_pass_chunk(
         params, x, pools, position, block_table, cos_rows, sin_rows,
         heads=cfg.heads, kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
-        layers=cfg.layers, eps=cfg.norm_eps,
+        layers=cfg.layers, eps=cfg.norm_eps, lora=lora,
     )
 
 
@@ -370,6 +383,59 @@ def page_pool_bytes(cfg: Qwen2Config, page_size: int,
     return values * jnp.dtype(L.compute_dtype()).itemsize
 
 
+def make_lora_pool(cfg: Qwen2Config, lora_dir, *, max_resident: int = 8,
+                   rank: int | None = None):
+    """Adapter catalog + resident pool for multi-tenant LoRA serving
+    (models/lora_pool.AdapterPool). ``lora_dir`` holds one
+    ``<name>.npz`` per servable adapter with per-layer keys ``a_{i}``
+    [dim, r] / ``b_{i}`` [r, dim]; the file stem is the tenant name
+    requests route on (the OpenAI ``model`` field).
+
+    The resident stack is homogeneous in rank: ``rank`` defaults to
+    the LARGEST rank in the catalog and smaller adapters are
+    zero-padded into it (zero rows/cols contribute exactly zero to the
+    delta), so admission never changes stack shapes — the
+    zero-steady-state-compile contract. See KNOWN_ISSUES round 19 for
+    the rank ceiling (128-lane tile) and undersized-pool thrash."""
+    import os
+
+    import numpy as np
+
+    from dora_tpu.models.lora_pool import AdapterPool
+
+    files = {
+        f[: -len(".npz")]: os.path.join(lora_dir, f)
+        for f in sorted(os.listdir(lora_dir))
+        if f.endswith(".npz")
+    }
+    if not files:
+        raise ValueError(f"DORA_LORA_DIR {lora_dir!r} has no .npz adapters")
+    if rank is None:
+        rank = 1
+        for path in files.values():
+            with np.load(path) as z:
+                rank = max(rank, z["a_0"].shape[-1])
+    dtype = L.compute_dtype()
+    template = {
+        "a": jnp.zeros((cfg.layers, cfg.dim, rank), dtype),
+        "b": jnp.zeros((cfg.layers, rank, cfg.dim), dtype),
+    }
+
+    def loader(name):
+        with np.load(files[name]) as z:
+            a = np.stack([z[f"a_{i}"] for i in range(cfg.layers)])
+            b = np.stack([z[f"b_{i}"] for i in range(cfg.layers)])
+        r = a.shape[-1]
+        assert r <= rank, (name, r, rank)
+        a = np.pad(a, ((0, 0), (0, 0), (0, rank - r)))
+        b = np.pad(b, ((0, 0), (0, rank - r), (0, 0)))
+        return {"a": jnp.asarray(a, dtype), "b": jnp.asarray(b, dtype)}
+
+    return AdapterPool(
+        loader, template, max_resident=max_resident, known=set(files)
+    )
+
+
 def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
                       eos: int | None = None, page_size: int = 16,
                       chunk: int | None = None,
@@ -379,7 +445,9 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
                       spec_ngram: int | None = None,
                       prefix_cache: bool | None = None,
                       prefix_cache_pages: int | None = None,
-                      kv_int8: bool | None = None):
+                      kv_int8: bool | None = None,
+                      lora_dir: str | None = None,
+                      lora_max_resident: int | None = None):
     """Paged-KV continuous-batching engine (requires the quantized fused
     layout, like :func:`make_batch_engine`). Defaults size the pool to
     EXACTLY the dense engine's 4-slot HBM footprint (4 * max_seq KV
@@ -403,7 +471,16 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
     checks them all — up to ``window * (spec_k + 1)`` tokens per
     dispatch, token-identical to ``spec_k = 0`` (verification replays
     the serial spec_decode acceptance test). ``spec_k = 0`` builds
-    today's window program, byte-identical."""
+    today's window program, byte-identical.
+
+    ``lora_dir`` (default: env ``DORA_LORA_DIR``) enables multi-tenant
+    LoRA serving: the engine carries a refcounted resident-adapter
+    pool (:func:`make_lora_pool`, sized by ``lora_max_resident`` /
+    env ``DORA_LORA_MAX_RESIDENT``, default 8) and the fused window
+    applies each stream's residual-stream adapter delta through the
+    grouped Pallas gather-matmul (ops/lora.py). Adapter ids are TRACED
+    data — mixed-tenant batches share one window executable and
+    adapter churn rewrites pool slot contents without recompiling."""
     import os
 
     from dora_tpu.models import vlm as _vlm
@@ -442,41 +519,83 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         prefix_cache_pages = int(
             os.environ.get("DORA_PREFIX_CACHE_PAGES", "0")
         )
+    if lora_dir is None:
+        lora_dir = os.environ.get("DORA_LORA_DIR") or None
+    lora_pool = None
+    if lora_dir:
+        if lora_max_resident is None:
+            lora_max_resident = int(
+                os.environ.get("DORA_LORA_MAX_RESIDENT", "8")
+            )
+        rank_env = os.environ.get("DORA_LORA_RANK")
+        lora_pool = make_lora_pool(
+            cfg, lora_dir, max_resident=lora_max_resident,
+            rank=int(rank_env) if rank_env else None,
+        )
+
     def window_factory(k, sk):
         # (k, spec) -> jitted window program; PagedBatchEngine caches
         # built programs so the autotuner's ladder compiles each rung
         # once per process.
         if sk:
+            if lora_pool is not None:
+                def spec_step(chunks, pools, positions, bts, adapters, ls):
+                    return fused_paged_spec_step(
+                        params, cfg, chunks, pools, positions, bts,
+                        lora=(adapters, ls["a"], ls["b"]),
+                    )
+            else:
+                def spec_step(chunks, pools, positions, bts):
+                    return fused_paged_spec_step(
+                        params, cfg, chunks, pools, positions, bts
+                    )
             return jax.jit(
                 _vlm.make_paged_spec_window(
-                    lambda chunks, pools, positions, bts: fused_paged_spec_step(
-                        params, cfg, chunks, pools, positions, bts
-                    ),
+                    spec_step,
                     k=k,
                     spec_k=sk,
                     ngram=spec_ngram,
                     eos=eos,
+                    lora=lora_pool is not None,
                 ),
                 donate_argnums=(1,),
             )
+        if lora_pool is not None:
+            def batch_step(tokens, pools, positions, bts, adapters, ls):
+                return fused_paged_batch_step(
+                    params, cfg, tokens, pools, positions, bts,
+                    lora=(adapters, ls["a"], ls["b"]),
+                )
+        else:
+            def batch_step(tokens, pools, positions, bts):
+                return fused_paged_batch_step(
+                    params, cfg, tokens, pools, positions, bts
+                )
         return jax.jit(
             _vlm.make_paged_window(
-                lambda tokens, pools, positions, bts: fused_paged_batch_step(
-                    params, cfg, tokens, pools, positions, bts
-                ),
-                k=k,
-                eos=eos,
+                batch_step, k=k, eos=eos, lora=lora_pool is not None,
             ),
             donate_argnums=(1,),
         )
 
     window_fn = window_factory(window, spec_k)
-    chunk_fn = jax.jit(
-        lambda ids, pools, position, bt: fused_paged_chunk_step(
-            params, cfg, ids, pools, position, bt
-        ),
-        donate_argnums=(1,),
-    )
+    if lora_pool is not None:
+        chunk_fn = jax.jit(
+            lambda ids, pools, position, bt, adapter, ls: (
+                fused_paged_chunk_step(
+                    params, cfg, ids, pools, position, bt,
+                    lora=(adapter, ls["a"], ls["b"]),
+                )
+            ),
+            donate_argnums=(1,),
+        )
+    else:
+        chunk_fn = jax.jit(
+            lambda ids, pools, position, bt: fused_paged_chunk_step(
+                params, cfg, ids, pools, position, bt
+            ),
+            donate_argnums=(1,),
+        )
     engine = PagedBatchEngine(
         init_pool=lambda n: init_page_pool(cfg, n, page_size,
                                            kv_int8=kv_int8),
@@ -494,6 +613,7 @@ def make_paged_engine(params, cfg: Qwen2Config, *, max_slots: int = 16,
         spec_ngram=spec_ngram,
         prefix_cache=prefix_cache,
         prefix_cache_pages=prefix_cache_pages,
+        lora_pool=lora_pool,
     )
     # Device utilization plane constants: the analytic per-token FLOPs
     # of this config and the device's advertised peak, feeding the

@@ -1,0 +1,204 @@
+"""Refcounted resident-adapter pool for multi-tenant LoRA serving.
+
+The engine serves thousands of tenants but HBM holds only
+``max_resident`` adapter weight sets at once. This pool is the
+adapter-plane analogue of the KV ``PageAllocator`` + radix cache
+custody model (models/batch_engine, models/prefix_cache):
+
+* A fixed device STACK of ``max_resident + 1`` adapter slots per
+  weight leaf — slot 0 is the reserved all-zeros base (the null-page
+  idiom: adapter-less rows gather slot 0 and get an exact zero delta).
+  The stack's shape NEVER changes; admission and eviction rewrite slot
+  contents with a single jitted donated scatter, so adapter churn adds
+  zero steady-state XLA compiles (the window sees one fixed-shape
+  traced operand forever).
+* ``acquire(name)`` refcounts residency per live stream: a resident
+  adapter bumps its refcount and LRU stamp; a non-resident one loads
+  into a free slot, evicting the least-recently-used refcount-0
+  resident if the pool is full (mirroring the prefix cache's
+  LRU-leaf-first discipline — an adapter still pinned by live streams
+  is never swapped out from under them). Returns ``None`` when every
+  slot is pinned — the admission-control signal.
+* ``fits(name)`` answers admission WITHOUT side effects (the engine's
+  ``can_admit`` counts adapter residency the way it counts pages).
+
+The ``loader(name)`` callback returns the adapter's host weight
+pytree shaped like one stack slot (e.g. ``{"a": [L, D, r],
+"b": [L, r, D]}`` for the fused decode path, or a scalar shift for
+the stub engine); the pool is agnostic to what an adapter IS.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+
+class AdapterPool:
+    """See module docstring. One instance per PagedBatchEngine; all
+    methods run on the scheduler thread (no locking)."""
+
+    def __init__(self, loader, template, *, max_resident: int,
+                 known: set[str] | None = None):
+        """``template`` is one zero slot of the stack (a host/device
+        pytree); the resident stack is built as ``max_resident + 1``
+        stacked copies with slot 0 permanently zero. ``known`` is the
+        servable-adapter catalog (e.g. the ``DORA_LORA_DIR`` listing);
+        None means every name is synthesizable (the stub engine)."""
+        assert max_resident >= 1, "need at least one resident adapter slot"
+        self.loader = loader
+        self.known = known
+        self.max_resident = max_resident
+        self._state = jax.tree.map(
+            lambda leaf: jnp.stack(
+                [jnp.zeros_like(jnp.asarray(leaf))] * (max_resident + 1)
+            ),
+            template,
+        )
+        self._write = jax.jit(
+            lambda state, idx, slot: jax.tree.map(
+                lambda s, a: s.at[idx].set(a.astype(s.dtype)), state, slot
+            ),
+            donate_argnums=(0,),
+        )
+        #: name -> resident slot index (1..max_resident)
+        self._resident: dict[str, int] = {}
+        self._refs: dict[str, int] = {}
+        self._last_used: dict[str, int] = {}
+        self._free = list(range(1, max_resident + 1))
+        self._clock = itertools.count(1)
+        # -- accounting (cumulative; surfaced via ServingMetrics) --
+        self.loads = 0
+        self.evictions = 0
+
+    # -- admission -----------------------------------------------------------
+
+    def slot_of(self, name: str | None) -> int | None:
+        """Resident slot index of ``name`` (0 for base/None), or None
+        when not resident."""
+        if not name:
+            return 0
+        return self._resident.get(name)
+
+    def has(self, name: str | None) -> bool:
+        """Is ``name`` a servable adapter AT ALL (resident or
+        loadable from the catalog)? The admission-time routing check —
+        an unknown tenant is rejected up front, never parked."""
+        if not name:
+            return True
+        return (
+            name in self._resident
+            or self.known is None
+            or name in self.known
+        )
+
+    def fits(self, name: str | None) -> bool:
+        """Could ``acquire(name)`` succeed right now? Resident, a free
+        slot, or an evictable (refcount-0) resident exists."""
+        if not name or name in self._resident or self._free:
+            return True
+        return any(self._refs.get(n, 0) == 0 for n in self._resident)
+
+    def acquire(self, name: str | None) -> int | None:
+        """Pin ``name`` resident for one stream and return its slot
+        index (0 for base — never loaded, never refcounted). Loads and,
+        if needed, evicts the LRU refcount-0 resident. Returns None
+        when the pool is full of pinned adapters (admission must
+        reject or queue)."""
+        if not name:
+            return 0
+        idx = self._resident.get(name)
+        if idx is None:
+            idx = self._admit(name)
+            if idx is None:
+                return None
+        self._refs[name] = self._refs.get(name, 0) + 1
+        self._last_used[name] = next(self._clock)
+        return idx
+
+    def release(self, name: str | None) -> None:
+        """Drop one stream's pin; the adapter STAYS resident (warm for
+        the next request) until eviction needs its slot."""
+        if not name or name not in self._refs:
+            return
+        self._refs[name] = max(0, self._refs[name] - 1)
+
+    def _admit(self, name: str) -> int | None:
+        if self._free:
+            idx = self._free.pop()
+        else:
+            victim = min(
+                (
+                    n
+                    for n in self._resident
+                    if self._refs.get(n, 0) == 0
+                ),
+                key=lambda n: self._last_used.get(n, 0),
+                default=None,
+            )
+            if victim is None:
+                return None
+            idx = self._resident.pop(victim)
+            self._refs.pop(victim, None)
+            self._last_used.pop(victim, None)
+            self.evictions += 1
+        slot = self.loader(name)
+        self._state = self._write(
+            self._state, jnp.asarray(idx, jnp.int32), slot
+        )
+        self._resident[name] = idx
+        self.loads += 1
+        return idx
+
+    # -- the traced operand --------------------------------------------------
+
+    def state(self):
+        """The resident stack pytree — a FIXED-shape traced operand of
+        the fused window (slot axis first on every leaf)."""
+        return self._state
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def resident(self) -> int:
+        return len(self._resident)
+
+    def adapter_bytes(self) -> int:
+        """HBM bytes of ONE adapter slot (what ``fits()``-style byte
+        accounting charges per resident adapter)."""
+        total = sum(
+            leaf.nbytes for leaf in jax.tree.leaves(self._state)
+        )
+        return total // (self.max_resident + 1)
+
+    def resident_bytes(self) -> int:
+        return self.resident * self.adapter_bytes()
+
+    def streams_by_adapter(self) -> dict[str, int]:
+        """Live-stream pins per resident adapter (the per-tenant
+        streams gauge)."""
+        return {
+            n: self._refs.get(n, 0) for n in sorted(self._resident)
+        }
+
+    def stats(self) -> dict:
+        return {
+            "resident": self.resident,
+            "max_resident": self.max_resident,
+            "resident_bytes": self.resident_bytes(),
+            "loads": self.loads,
+            "evictions": self.evictions,
+            "streams": self.streams_by_adapter(),
+        }
+
+    def check_invariants(self) -> None:
+        assert len(self._resident) + len(self._free) == self.max_resident
+        assert all(
+            1 <= i <= self.max_resident for i in self._resident.values()
+        )
+        assert len(set(self._resident.values())) == len(self._resident)
+        for name, refs in self._refs.items():
+            assert refs >= 0, (name, refs)
+            assert name in self._resident or refs == 0

@@ -33,6 +33,14 @@ Custody is refcounts, not copies (models/batch_engine.PageAllocator):
 Token ids are exact-match keys (no hashing, no collisions): two
 prompts share a node only when their page-size chunk of token ids is
 identical, which is the greedy-exactness contract.
+
+Multi-tenant LoRA serving adds an ``adapter`` dimension to that
+contract: the KV a stream computes depends on its adapter's weights,
+so two tenants with byte-identical prompts must NEVER share pages.
+The cache therefore keys every path on ``(adapter, tokens)`` — one
+radix root per adapter identity (the stable tenant NAME, not the
+resident slot index, which is recycled by eviction) — and eviction /
+accounting walk all roots.
 """
 
 from __future__ import annotations
@@ -63,6 +71,11 @@ class PrefixCache:
         #: pressure); insert evicts LRU past it
         self.max_pages = max_pages
         self._root = _Node((), None, None)
+        #: adapter identity -> radix root; None/"" is the base tenant.
+        #: Tenant isolation lives here: lookups only ever walk their
+        #: own adapter's tree, so cross-tenant hits are structurally
+        #: impossible.
+        self._roots: dict[str | None, _Node] = {None: self._root}
         self._clock = itertools.count(1)
         #: pages (== nodes) currently held by the cache
         self.size = 0
@@ -85,9 +98,19 @@ class PrefixCache:
             for i in range(0, (len(ids) // ps) * ps, ps)
         ]
 
+    def _root_for(self, adapter: str | None, create: bool = False) -> _Node:
+        root = self._roots.get(adapter or None)
+        if root is None:
+            root = _Node((), None, None)
+            if create:
+                self._roots[adapter or None] = root
+        return root
+
     # -- lookup / insert -----------------------------------------------------
 
-    def lookup(self, ids) -> tuple[int, list[int], bool]:
+    def lookup(
+        self, ids, adapter: str | None = None
+    ) -> tuple[int, list[int], bool]:
         """Longest cached page-aligned prefix of ``ids``.
 
         Returns ``(matched_tokens, pages, mid_page)``: the matched
@@ -96,9 +119,10 @@ class PrefixCache:
         cached page (some cached edge shares a proper prefix with the
         next chunk — the copy-on-write boundary case). Touches the
         matched path's LRU stamps; hit/miss accounting is the
-        engine's, made against the prefix length it actually maps."""
+        engine's, made against the prefix length it actually maps.
+        ``adapter`` scopes the walk to that tenant's tree."""
         now = next(self._clock)
-        node = self._root
+        node = self._root_for(adapter)
         pages: list[int] = []
         for key in self._chunks(ids):
             child = node.children.get(key)
@@ -114,14 +138,15 @@ class PrefixCache:
         )
         return matched, pages, mid_page
 
-    def insert(self, ids, pages: list[int]) -> int:
+    def insert(self, ids, pages: list[int], adapter: str | None = None) -> int:
         """Adopt a completed prompt's fully-populated pages: one node
         per page-size chunk of ``ids``, each new node taking one
         allocator reference on its page. Existing nodes keep their
         page (first writer wins — the duplicate page stays private to
-        its stream and frees with it). Returns pages adopted."""
+        its stream and frees with it). Returns pages adopted.
+        ``adapter`` scopes adoption to that tenant's tree."""
         now = next(self._clock)
-        node = self._root
+        node = self._root_for(adapter, create=True)
         new = 0
         for key, page in zip(self._chunks(ids), pages):
             child = node.children.get(key)
@@ -140,10 +165,10 @@ class PrefixCache:
 
     # -- pin / unpin (preempted victims) -------------------------------------
 
-    def pin(self, ids) -> int:
+    def pin(self, ids, adapter: str | None = None) -> int:
         """Pin the cached path matching ``ids`` against eviction (one
         pin per node; nestable). Returns the pinned token length."""
-        node = self._root
+        node = self._root_for(adapter)
         n = 0
         for key in self._chunks(ids):
             child = node.children.get(key)
@@ -154,11 +179,11 @@ class PrefixCache:
             node = child
         return n
 
-    def unpin(self, ids) -> None:
+    def unpin(self, ids, adapter: str | None = None) -> None:
         """Release one pin along the matching path (tolerates a path
         shorter than at pin time — impossible while pinned, but unpin
         must never raise on teardown)."""
-        node = self._root
+        node = self._root_for(adapter)
         for key in self._chunks(ids):
             child = node.children.get(key)
             if child is None:
@@ -176,6 +201,8 @@ class PrefixCache:
         pinned or in-use descendant keeps its ancestors reachable).
         Admission counts these as free-in-waiting."""
 
+        roots = set(self._roots.values())
+
         def walk(n: _Node) -> tuple[bool, int]:
             total = 0
             ok_all = True
@@ -183,7 +210,7 @@ class PrefixCache:
                 ok, cnt = walk(c)
                 total += cnt
                 ok_all = ok_all and ok
-            if n is self._root:
+            if n in roots:
                 return True, total
             ok = (
                 ok_all
@@ -192,7 +219,7 @@ class PrefixCache:
             )
             return ok, total + (1 if ok else 0)
 
-        return walk(self._root)[1]
+        return sum(walk(root)[1] for root in roots)
 
     def evict(self, need: int) -> int:
         """Free up to ``need`` pages, least-recently-used leaves first
@@ -202,7 +229,11 @@ class PrefixCache:
         freed = 0
         while freed < need:
             best: _Node | None = None
-            stack = list(self._root.children.values())
+            stack = [
+                c
+                for root in self._roots.values()
+                for c in root.children.values()
+            ]
             while stack:
                 n = stack.pop()
                 stack.extend(n.children.values())
@@ -228,8 +259,13 @@ class PrefixCache:
     # -- introspection -------------------------------------------------------
 
     def pages(self):
-        """Iterate every cached page id (invariant checks)."""
-        stack = list(self._root.children.values())
+        """Iterate every cached page id across all tenants (invariant
+        checks)."""
+        stack = [
+            c
+            for root in self._roots.values()
+            for c in root.children.values()
+        ]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())

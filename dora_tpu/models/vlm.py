@@ -337,13 +337,20 @@ def decode_chunk_fused(params, cfg: VLMConfig, tokens, caches, position):
 
 
 def _fused_pass(params, x, attn_apply, *, heads: int, kv_heads: int,
-                head_dim: int, layers: int, eps: float):
+                head_dim: int, layers: int, eps: float, lora=None):
     """Shared skeleton of every fused decode pass: per-layer quantized
     weight unpacking, bias zero-fill, the MLP sweep and the streamed
     lm_head argmax. ``attn_apply(layer_index, x, blk, wqkv, sqkv, bqkv,
     wo, swo) -> (x, cache_entry)`` supplies the attention variant
     (single-row / M-row chunk / B-row batch — they differ only in cache
-    indexing and position plumbing)."""
+    indexing and position plumbing).
+
+    ``lora`` (multi-tenant serving) is ``(groups [R], a_stack
+    [S, L, D, r], b_stack [S, L, r, D])``: per-layer rank-r
+    residual-stream adapters gathered per ROW by adapter id (slot 0 is
+    the all-zeros base, so adapter-less rows pay an exact zero delta)
+    — ops/lora.py's grouped gather-matmul, executed inside the fused
+    pass so a mixed-tenant batch stays ONE program."""
     from dora_tpu.ops import decode_block as DB
 
     n_qkv = (heads + 2 * kv_heads) * head_dim
@@ -365,6 +372,13 @@ def _fused_pass(params, x, attn_apply, *, heads: int, kv_heads: int,
         if bgu is None:
             bgu = jnp.zeros((2 * ffn,), jnp.float32)
         x = DB.mlp_step(x, blk["ffn_norm"], wgu, sgu, bgu, wd, sd, eps=eps)
+        if lora is not None:
+            from dora_tpu.ops.lora import lora_gather_matmul
+
+            groups, a_stack, b_stack = lora
+            x = x + lora_gather_matmul(
+                x, groups, a_stack[:, i], b_stack[:, i]
+            ).astype(x.dtype)
     wh, sh = _qw(params["lm_head"])
     greedy = DB.lm_head_argmax(x, params["out_norm"], wh, sh, eps=eps)
     return greedy, new_caches
@@ -491,7 +505,8 @@ def fused_decode_pass_batch(params, x, caches, positions, cos_rows,
 
 def fused_paged_pass_batch(params, x, pools, positions, block_tables,
                            cos_rows, sin_rows, *, heads: int, kv_heads: int,
-                           head_dim: int, layers: int, eps: float = 1e-6):
+                           head_dim: int, layers: int, eps: float = 1e-6,
+                           lora=None):
     """Batched fused pass over PAGED KV pools: per-layer K/V live as a
     pool of [P, KV, page, hd] blocks and each row's context streams
     through its ``block_tables`` row instead of a contiguous
@@ -520,13 +535,14 @@ def fused_paged_pass_batch(params, x, pools, positions, block_tables,
 
     return _fused_pass(
         params, x, attn_apply, heads=heads, kv_heads=kv_heads,
-        head_dim=head_dim, layers=layers, eps=eps,
+        head_dim=head_dim, layers=layers, eps=eps, lora=lora,
     )
 
 
 def fused_paged_pass_chunk(params, x, pools, position, block_table,
                            cos_rows, sin_rows, *, heads: int, kv_heads: int,
-                           head_dim: int, layers: int, eps: float = 1e-6):
+                           head_dim: int, layers: int, eps: float = 1e-6,
+                           lora=None):
     """One prefill CHUNK through the fused kernels into paged pools:
     x [M, dim] holds the chunk's embedded tokens at positions
     ``position..position+M-1`` (``position`` and M page-multiples — the
@@ -558,14 +574,14 @@ def fused_paged_pass_chunk(params, x, pools, position, block_table,
 
     return _fused_pass(
         params, x, attn_apply, heads=heads, kv_heads=kv_heads,
-        head_dim=head_dim, layers=layers, eps=eps,
+        head_dim=head_dim, layers=layers, eps=eps, lora=lora,
     )
 
 
 def fused_paged_pass_spec(params, x, pools, positions, block_tables,
                           cos_rows, sin_rows, *, heads: int, kv_heads: int,
                           head_dim: int, layers: int, m: int,
-                          eps: float = 1e-6):
+                          eps: float = 1e-6, lora=None):
     """Speculative VERIFICATION pass over paged KV pools: x [B*m, dim]
     holds, stream-major, each stream's m = k+1 candidate rows (last
     emitted token + its k drafts) at positions
@@ -598,11 +614,12 @@ def fused_paged_pass_spec(params, x, pools, positions, block_tables,
 
     return _fused_pass(
         params, x, attn_apply, heads=heads, kv_heads=kv_heads,
-        head_dim=head_dim, layers=layers, eps=eps,
+        head_dim=head_dim, layers=layers, eps=eps, lora=lora,
     )
 
 
-def make_paged_window(step_fn, *, k: int, eos: int | None = None):
+def make_paged_window(step_fn, *, k: int, eos: int | None = None,
+                      lora: bool = False):
     """Fused K-step decode window over a paged batch step.
 
     ONE jitted program runs ``k`` batched decode ticks on device,
@@ -630,15 +647,29 @@ def make_paged_window(step_fn, *, k: int, eos: int | None = None):
     max_new) -> (mat [B, k+1], tokens, positions, active, emitted,
     pools)`` — the carried state comes back so the host replaces its
     device refs and only rebuilds them when slot membership changes.
+
+    With ``lora=True`` (multi-tenant adapter serving) the window takes
+    two extra TRAILING traced operands — per-row adapter slot ids
+    ``adapters [B]`` and the resident adapter stack pytree — and
+    ``step_fn`` is called as ``step_fn(tokens, pools, positions, bts,
+    adapters, lora_state)``. Both are fixed-shape (the stack's slot
+    count never changes; admission/eviction rewrite contents), so the
+    single-program discipline extends to adapter churn.
     """
     from dora_tpu.ops import decode_block as DB
 
-    def window(tokens, pools, positions, bts, active, emitted, max_new):
+    def window(tokens, pools, positions, bts, active, emitted, max_new,
+               adapters=None, lora_state=None):
         def tick(carry, _):
             tokens, pools, positions, active, emitted = carry
             alive = active.astype(jnp.int32)
             pos_in, bts_in = DB.freeze_inactive(positions, bts, active)
-            nxt, pools = step_fn(tokens, pools, pos_in, bts_in)
+            if lora:
+                nxt, pools = step_fn(
+                    tokens, pools, pos_in, bts_in, adapters, lora_state
+                )
+            else:
+                nxt, pools = step_fn(tokens, pools, pos_in, bts_in)
             out = jnp.where(active, nxt, -1)  # -1 = row was frozen
             emitted = emitted + alive
             done = emitted >= max_new
@@ -664,7 +695,8 @@ def make_paged_window(step_fn, *, k: int, eos: int | None = None):
 
 
 def make_paged_spec_window(spec_step_fn, *, k: int, spec_k: int,
-                           ngram: int, eos: int | None = None):
+                           ngram: int, eos: int | None = None,
+                           lora: bool = False):
     """Fused K-step decode window with prompt-lookup SPECULATION folded
     into every tick: one dispatch can emit up to ``k * (spec_k + 1)``
     tokens per stream instead of ``k``.
@@ -704,6 +736,13 @@ def make_paged_spec_window(spec_step_fn, *, k: int, spec_k: int,
     buffers vs the base window: per-stream token history
     ``[B, hist_buf]`` and its lengths ``[B]``, which the engine
     rebuilds from its host mirror only when slot membership changes.
+
+    With ``lora=True`` the window takes the same two extra TRAILING
+    operands as :func:`make_paged_window` (``adapters [B]`` and the
+    resident adapter stack) and the verification pass is called as
+    ``spec_step_fn(chunks, pools, positions, bts, adapters,
+    lora_state)`` — drafts AND verify read the tenant's own adapter,
+    so acceptance is self-consistent per tenant.
     """
     from dora_tpu.models import spec_decode
     from dora_tpu.ops import decode_block as DB
@@ -711,7 +750,7 @@ def make_paged_spec_window(spec_step_fn, *, k: int, spec_k: int,
     m = spec_k + 1
 
     def window(tokens, pools, positions, bts, active, emitted, max_new,
-               history, hist_len):
+               history, hist_len, adapters=None, lora_state=None):
         hbuf = history.shape[1]
         nslots = tokens.shape[0]
 
@@ -724,7 +763,12 @@ def make_paged_spec_window(spec_step_fn, *, k: int, spec_k: int,
                 lambda h, hl: spec_decode.lookup(h, hl, hbuf, spec_k, ngram)
             )(history, hist_len)  # [B, spec_k]
             chunks = jnp.concatenate([tokens[:, None], draft], axis=1)
-            greedy, pools = spec_step_fn(chunks, pools, pos_in, bts_in)
+            if lora:
+                greedy, pools = spec_step_fn(
+                    chunks, pools, pos_in, bts_in, adapters, lora_state
+                )
+            else:
+                greedy, pools = spec_step_fn(chunks, pools, pos_in, bts_in)
             # The serial acceptance test (spec_decode.run_loop),
             # vectorised: longest agreeing draft prefix + bonus token.
             agree = greedy[:, :spec_k] == draft

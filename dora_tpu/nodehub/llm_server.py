@@ -130,6 +130,12 @@ def make_engine(params, cfg, eos=None):
 QOS_CLASSES = ("interactive", "standard", "batch")
 QOS_WEIGHTS = {"interactive": 8.0, "standard": 4.0, "batch": 1.0}
 
+#: request ``model`` values that mean "the base model" (no LoRA
+#: adapter): the OpenAI gateway's default, and the explicit aliases.
+#: Any OTHER name is a multi-tenant LoRA adapter, resolved against the
+#: engine's resident-adapter catalog (DORA_LORA_DIR stems).
+BASE_MODEL_NAMES = ("", "dora-tpu", "base")
+
 
 class QosConfig:
     """Traffic-shaping knobs, from the descriptor ``qos:`` block (the
@@ -226,7 +232,10 @@ class AdmissionQueue:
         self._qos = qos or QosConfig()
         self._on_shed = on_shed
         self._preempt = preempt
-        #: class -> [[key, ids, max_new, t_in, deadline_s], ...] FIFO
+        #: class -> [[key, ids, max_new, t_in, deadline_s, adapter], ...]
+        #: FIFO. ``adapter`` is the stream's LoRA tenant (None = base);
+        #: it parks with the request and rides admission into
+        #: ``engine.submit`` — a parked tenant must not lose its model.
         self._q: dict[str, list[list]] = {c: [] for c in QOS_CLASSES}
 
     def __len__(self) -> int:
@@ -243,7 +252,8 @@ class AdmissionQueue:
         )
 
     def push(self, key: str, ids: list[int], max_new: int,
-             qos: str | None = None, deadline_s: float | None = None) -> bool:
+             qos: str | None = None, deadline_s: float | None = None,
+             adapter: str | None = None) -> bool:
         """Park (then drain). Returns False when the entry was shed at
         the door because its class queue is at its depth bound."""
         cls = qos if qos in QOS_CLASSES else self._qos.default_class
@@ -252,18 +262,23 @@ class AdmissionQueue:
             if self._on_shed is not None:
                 self._on_shed(key, f"depth:{cls}", 0.0)
             return False
-        self._q[cls].append([key, ids, max_new, self._clock(), deadline_s])
+        self._q[cls].append(
+            [key, ids, max_new, self._clock(), deadline_s, adapter]
+        )
         self.drain()
         return True
 
     def requeue(self, key: str, ids: list[int], max_new: int,
-                qos: str | None = None) -> None:
+                qos: str | None = None,
+                adapter: str | None = None) -> None:
         """Park a preempted stream at the FRONT of its class, wait clock
         reset (aging credit is forfeited — a re-aged victim outscoring
         its preemptor would ping-pong the slot). No drain: only called
         from inside the preempt hook, mid-drain."""
         cls = qos if qos in QOS_CLASSES else self._qos.default_class
-        self._q[cls].insert(0, [key, ids, max_new, self._clock(), None])
+        self._q[cls].insert(
+            0, [key, ids, max_new, self._clock(), None, adapter]
+        )
 
     def _shed_expired(self) -> None:
         if self._on_shed is None:
@@ -302,27 +317,40 @@ class AdmissionQueue:
             cls = self._best(now)
             if cls is None:
                 return
-            key, ids, max_new, t_in, _dl = self._q[cls][0]
-            if not self._engine.can_admit(len(ids), max_new):
+            key, ids, max_new, t_in, _dl, adapter = self._q[cls][0]
+            # Dense engines predate the adapter kwarg; only paged
+            # engines ever have a lora pool, and only they see tenant
+            # requests (the front door rejects tenants otherwise).
+            admissible = (
+                self._engine.can_admit(len(ids), max_new, adapter)
+                if adapter
+                else self._engine.can_admit(len(ids), max_new)
+            )
+            if not admissible:
                 if self._preempt is not None and self._preempt(cls):
                     continue  # a victim was evicted: re-score and retry
                 return
             self._q[cls].pop(0)
             if self._on_admit is not None:
                 self._on_admit(key, now - t_in)
-            self._start(key, ids, max_new)
+            # Same compatibility split as can_admit: pre-adapter start
+            # callbacks take exactly (key, ids, max_new).
+            if adapter:
+                self._start(key, ids, max_new, adapter)
+            else:
+                self._start(key, ids, max_new)
 
-    def pending(self) -> list[tuple[str, list[int], int, str]]:
+    def pending(self) -> list[tuple[str, list[int], int, str, str | None]]:
         """Parked requests in class-priority order — serialized into
         checkpoints and migration handoffs (the wait-start time and
         deadline are process-local and deliberately dropped)."""
         return [
-            (k, list(ids), mn, cls)
+            (k, list(ids), mn, cls, ad)
             for cls in QOS_CLASSES
-            for k, ids, mn, _t, _dl in self._q[cls]
+            for k, ids, mn, _t, _dl, ad in self._q[cls]
         ]
 
-    def take_all(self) -> list[tuple[str, list[int], int, str]]:
+    def take_all(self) -> list[tuple[str, list[int], int, str, str | None]]:
         """Drain the backlog without starting anything (migrate-out:
         parked requests travel with the live streams)."""
         out = self.pending()
@@ -479,6 +507,10 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
     #: exist so a preempted stream can resume by re-prefilling
     #: prompt + emitted — only tracked while preemption is on.
     req_class: dict[str, str] = {}
+    #: engine key -> LoRA tenant name (absent/None = base model). Kept
+    #: for every request while live so preemption requeues and
+    #: migrate-out carry the stream's model with it.
+    req_adapter: dict[str, str | None] = {}
     req_prompt: dict[str, list[int]] = {}
     req_emitted: dict[str, list[int]] = {}
     admit_seq: dict[str, int] = {}
@@ -511,6 +543,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
 
     def _forget(key: str) -> None:
         req_class.pop(key, None)
+        req_adapter.pop(key, None)
         req_prompt.pop(key, None)
         req_emitted.pop(key, None)
         admit_seq.pop(key, None)
@@ -577,7 +610,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         # start from the duration, so it covers the whole backlog wait.
         tracer.span("s_queued", key, dur_ns=int(waited_s * 1e9))
 
-    def start(key: str, ids: list[int], max_new: int) -> None:
+    def start(key: str, ids: list[int], max_new: int,
+              adapter: str | None = None) -> None:
         admit_counter[0] += 1
         admit_seq[key] = admit_counter[0]
         if key in preempted_keys:
@@ -587,7 +621,10 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             preempted_keys.discard(key)
             metrics.resumed += 1
             tracer.span("s_resume", key, f"recompute={len(ids)}")
-        res = engine.submit(key, ids, max_new)
+        if adapter:
+            res = engine.submit(key, ids, max_new, adapter=adapter)
+        else:
+            res = engine.submit(key, ids, max_new)
         pinned = pinned_prefix.pop(key, None)
         if pinned is not None:
             # Unpin AFTER submit: the resume lookup refs the shared
@@ -657,7 +694,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             # tail instead of re-paying the whole prefill.
             pinned_prefix[victim] = resume_ids
         backlog.requeue(victim, resume_ids, remaining,
-                       req_class.get(victim))
+                       req_class.get(victim),
+                       adapter=req_adapter.get(victim))
         return True
 
     #: requests that arrived while the engine couldn't admit them
@@ -710,13 +748,37 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             dl = 0.0
         deadline_s = dl if dl > 0 else None
         req_class[key] = cls
+        # Per-request model routing (the OpenAI ``model`` field, wired
+        # through like qos_class): a non-base name is a LoRA tenant
+        # served out of THIS engine's adapter pool — same slots, same
+        # pages, one window executable.
+        model = str(meta.get("model") or "")
+        adapter = model if model not in BASE_MODEL_NAMES else None
+        lora_pool = getattr(engine, "lora", None)
+        req_adapter[key] = adapter
         if max_new <= 0:
             # max_tokens <= 0 asks for nothing: close the stream
             # empty instead of fabricating a token.
             metrics.rejected += 1
             tracer.instant("s_reject", key, "max_new<=0")
             emit_text(key, "", True, finish="length")
-        elif not engine.fits(len(ids), max_new):
+        elif adapter is not None and (
+            lora_pool is None or not lora_pool.has(adapter)
+        ):
+            # Unknown tenant: NEVER servable here (no catalog entry /
+            # no adapter pool at all) — a structured non-retriable
+            # reject, distinct from capacity signals.
+            metrics.rejected += 1
+            tracer.instant("s_reject", key, f"unknown model {adapter!r}")
+            emit_text(
+                key, "", True, finish="rejected",
+                extra={"reject_reason": "unknown_model", "model": adapter},
+            )
+        elif not (
+            engine.fits(len(ids), max_new, adapter)
+            if adapter
+            else engine.fits(len(ids), max_new)
+        ):
             # NEVER admissible: close the stream empty with a
             # structured retriable "rejected" (distinct from the shed
             # path's "overloaded" — retrying the same body cannot
@@ -737,7 +799,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             if can_preempt:
                 req_prompt[key] = list(ids)
                 req_emitted[key] = []
-            if not backlog.push(key, ids, max_new, cls, deadline_s):
+            if not backlog.push(key, ids, max_new, cls, deadline_s,
+                                adapter=adapter):
                 return  # shed at the door (class depth bound)
             # push drains: admits now when the engine can, else parks
             # until capacity frees
@@ -1000,6 +1063,14 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 metrics.kv_pool_bytes = engine.kv_pool_bytes()
             if hasattr(engine, "kv_quant_error"):
                 metrics.kv_quant_err = engine.kv_quant_error()
+            lp = getattr(engine, "lora", None)
+            if lp is not None:
+                metrics.lora_resident = lp.resident
+                metrics.lora_max_resident = lp.max_resident
+                metrics.lora_resident_bytes = lp.resident_bytes()
+                metrics.lora_loads = lp.loads
+                metrics.lora_evictions = lp.evictions
+                metrics.adapter_streams = lp.streams_by_adapter()
         metrics.qos_depth = backlog.depths()
         metrics.autotune_k = getattr(engine, "window", 0)
         if monitor is not None:
@@ -1055,8 +1126,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         state = {
             "engine": engine.checkpoint_state(),
             "backlog": [
-                [k, list(ids), mn, cls]
-                for k, ids, mn, cls in backlog.pending()
+                [k, list(ids), mn, cls, ad]
+                for k, ids, mn, cls, ad in backlog.pending()
             ],
             "wire_ids": dict(wire_ids),
             "seqs": dict(seqs),
@@ -1113,10 +1184,13 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             tracer.begin(k, ctx or "")
         restored = engine.restore_state(saved.get("engine") or {"slots": []})
         for entry in saved.get("backlog") or []:
-            # Entries are [k, ids, max_new] pre-QoS, [.., class] after;
-            # the wait clock and any deadline restart on restore.
+            # Entries are [k, ids, max_new] pre-QoS, [.., class] after,
+            # [.., adapter] after multi-tenant LoRA; the wait clock and
+            # any deadline restart on restore.
             cls = entry[3] if len(entry) > 3 else None
-            backlog.push(entry[0], list(entry[1]), int(entry[2]), cls)
+            ad = entry[4] if len(entry) > 4 else None
+            backlog.push(entry[0], list(entry[1]), int(entry[2]), cls,
+                         adapter=ad)
         metrics.restored_streams += len(restored)
         tracer.span(
             "s_restore", "(engine)", f"streams={len(restored)}",
@@ -1141,7 +1215,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         payload = {
             "engine": state,
             "backlog": [
-                [k, list(ids), mn, cls] for k, ids, mn, cls in parked
+                [k, list(ids), mn, cls, ad]
+                for k, ids, mn, cls, ad in parked
             ],
             "wire_ids": {k: wire_ids.get(k) for k in keys},
             "seqs": {k: seqs.get(k, 0) for k in keys},
@@ -1189,6 +1264,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             (
                 fresh(entry[0]), list(entry[1]), int(entry[2]),
                 entry[3] if len(entry) > 3 else None,
+                entry[4] if len(entry) > 4 else None,
             )
             for entry in payload.get("backlog") or []
         ]
@@ -1221,8 +1297,8 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 tracer.instant("s_reject", nk, f"migrate-in overflow {src}")
                 emit_text(nk, "", True, finish="error")
             return
-        for nk, ids, mn, cls in parked:
-            backlog.push(nk, ids, mn, cls)
+        for nk, ids, mn, cls, ad in parked:
+            backlog.push(nk, ids, mn, cls, adapter=ad)
         dur = int((clock() - t0) * 1e9)
         for nk in mapping.values():
             tracer.span("s_migrate_in", nk, f"from={src}", dur_ns=dur)
@@ -1238,6 +1314,14 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             return False
         pages = 0
         for m in metas:
+            ad = m.get("adapter")
+            if ad:
+                # Tenant custody rides the stream: the target must be
+                # able to serve (load) the stream's adapter or the
+                # handoff stays on disk for a peer that can.
+                lp = getattr(engine, "lora", None)
+                if lp is None or not lp.has(ad):
+                    return False
             if m.get("decode"):
                 n = len(m.get("pages") or ())
                 if n * engine.page_size > engine.max_seq:
@@ -1249,6 +1333,12 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 if not engine.fits(plen, mn):
                     return False
                 pages += engine.pages_needed(plen, mn)
+        for entry in payload.get("backlog") or []:
+            ad = entry[4] if len(entry) > 4 else None
+            if ad:
+                lp = getattr(engine, "lora", None)
+                if lp is None or not lp.has(ad):
+                    return False
         return pages <= engine.free_pages
 
     def poll_migrate_in() -> None:
@@ -1387,6 +1477,13 @@ def _stub_main() -> None:
         prefix_cache=os.environ.get("DORA_PREFIX_CACHE", "1") != "0",
         prefix_cache_pages=int(
             os.environ.get("DORA_PREFIX_CACHE_PAGES", "0") or 0
+        ),
+        # Multi-tenant LoRA front door over the stub (any model name
+        # resolves to a deterministic shift adapter — see
+        # make_stub_paged_engine): the --lora-ab bench and the routing
+        # tests exercise admission/eviction/gauges engine-free.
+        lora_max_resident=int(
+            os.environ.get("DORA_LORA_MAX_RESIDENT", "0") or 0
         ),
     )
     delay = float(os.environ.get("DORA_STEP_DELAY_S", "0") or 0)

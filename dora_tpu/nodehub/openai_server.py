@@ -170,6 +170,13 @@ def main() -> None:
                 meta = {"request_id": rid}
                 if isinstance(body.get("max_tokens"), int):
                     meta["max_new_tokens"] = body["max_tokens"]
+                # Multi-tenant LoRA routing: the requested model name
+                # travels with the request; the serving node resolves a
+                # non-base name against its adapter catalog and rejects
+                # unknown tenants with a structured finish (so the 404
+                # semantics live where the catalog lives, not here).
+                if isinstance(model, str) and model:
+                    meta["model"] = model
                 # Traffic shaping: the body wins over the header so a
                 # proxy-injected default never overrides an explicit
                 # request. Unknown class strings pass through — the

@@ -79,6 +79,12 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_tpu_device_fetch_ns_total": ("counter", "Device-to-host fetch nanoseconds after each window"),
     "dora_tpu_device_flops_total": ("counter", "Useful FLOPs: emitted tokens x analytic per-token model"),
     "dora_tpu_device_dispatched_flops_total": ("counter", "Dispatched FLOPs including frozen rows and rejected speculative tails"),
+    "dora_serving_lora_resident": ("gauge", "LoRA adapters resident in the device pool"),
+    "dora_serving_lora_max_resident": ("gauge", "Resident-adapter pool capacity"),
+    "dora_serving_lora_resident_bytes": ("gauge", "Device bytes held by resident LoRA adapters"),
+    "dora_serving_lora_loads_total": ("counter", "LoRA adapters loaded into the resident pool"),
+    "dora_serving_lora_evictions_total": ("counter", "LoRA adapters evicted from the resident pool (LRU)"),
+    "dora_serving_adapter_streams": ("gauge", "Live streams pinned per resident LoRA adapter"),
 }
 
 #: (snapshot serving key, metric family) pairs for the per-node scalars
@@ -103,6 +109,8 @@ _SERVING_COUNTERS = (
     ("device_fetch_ns", "dora_tpu_device_fetch_ns_total"),
     ("useful_flops", "dora_tpu_device_flops_total"),
     ("dispatched_flops", "dora_tpu_device_dispatched_flops_total"),
+    ("lora_loads", "dora_serving_lora_loads_total"),
+    ("lora_evictions", "dora_serving_lora_evictions_total"),
 )
 _SERVING_GAUGES = (
     ("slots_active", "dora_serving_slots_active"),
@@ -124,6 +132,9 @@ _SERVING_GAUGES = (
     ("hbm_peak_bytes", "dora_tpu_device_hbm_peak_bytes"),
     ("kv_pool_bytes", "dora_serving_kv_pool_bytes"),
     ("kv_quant_err", "dora_serving_kv_quant_err"),
+    ("lora_resident", "dora_serving_lora_resident"),
+    ("lora_max_resident", "dora_serving_lora_max_resident"),
+    ("lora_resident_bytes", "dora_serving_lora_resident_bytes"),
 )
 
 
@@ -178,6 +189,12 @@ def iter_samples(
                     "dora_serving_qos_depth",
                     {**labels, "class": cls},
                     depth or 0,
+                )
+            for name, n in (s.get("adapter_streams") or {}).items():
+                yield (
+                    "dora_serving_adapter_streams",
+                    {**labels, "adapter": name},
+                    n or 0,
                 )
             ttft = s.get("ttft_us") or {}
             for p in (50, 90, 99):
@@ -388,6 +405,12 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "kv_pool_bytes": 2 << 30,
                     "kv_quant_err": 0.004,
                     "qos_depth": {"interactive": 0, "standard": 1, "batch": 3},
+                    "lora_resident": 2,
+                    "lora_max_resident": 8,
+                    "lora_resident_bytes": 64 << 20,
+                    "lora_loads": 9,
+                    "lora_evictions": 7,
+                    "adapter_streams": {"tenant-a": 2, 'b "quoted"': 1},
                     "ttft_us": hist.snapshot(),
                 }
             },

@@ -55,6 +55,16 @@ WATCHED: dict[str, str] = {
     # pool's byte budget, int8 vs fp — a drift downward means the
     # scale-plane overhead grew (the gate is >= 1.8).
     "serving_quant_ab.capacity.int8_capacity_ratio": "higher",
+    # Spec acceptance under int8 KV: the round-18 guidance is that
+    # acceptance counters, not token identity, are the drift signal
+    # when KV is quantized — a downward drift means rounding started
+    # flipping draft verifications.
+    "serving_quant_ab.spec.spec_acceptance": "higher",
+    # Multi-tenant LoRA: aggregate tok/s of one N-adapter engine vs N
+    # single-tenant engines in the same HBM budget — a drift toward
+    # 1.0 means the shared fused window stopped amortizing across
+    # tenants (the gate is >= 1.5).
+    "serving_lora_ab.lora_aggregate_ratio": "higher",
 }
 
 #: flag when a watched metric is worse than the previous run by more
