@@ -33,7 +33,7 @@ Prints exactly ONE JSON line (the last line of stdout):
    "p50_us_1kib": {...}, "p99_us_1kib": {...},
    "recorder_ab": {"off_msgs_per_sec": ..., "on_msgs_per_sec": ...,
                    "overhead_pct": ...},
-   "history_prom_ab": {...}, "trend": {...},
+   "history_prom_ab": {...}, "alerts_ab": {...}, "trend": {...},
    "e2e_fps": ..., "e2e_vs_north_star": ...}
 
 Every run is also appended to ``BENCH_history.jsonl`` (see
@@ -506,6 +506,52 @@ def history_prom_ab_leg() -> dict:
             )
         print(
             f"# history/prom A/B run {i + 1}/{SMALL_RUNS}: "
+            f"off {off[-1]:.0f} msg/s, on {on[-1]:.0f} msg/s",
+            file=sys.stderr,
+        )
+    off_m = statistics.median(off)
+    on_m = statistics.median(on)
+    return {
+        "off_msgs_per_sec": round(off_m, 0),
+        "on_msgs_per_sec": round(on_m, 0),
+        "overhead_pct": (
+            round((off_m - on_m) / off_m * 100, 2) if off_m else None
+        ),
+    }
+
+
+def alerts_ab_leg() -> dict:
+    """Alerting-plane A/B on the daemon route: history sampling at the
+    same aggressive 0.5 s cadence on both sides so the only difference
+    is the alert engine (DORA_ALERTS=0 vs =1), runs interleaved. Each
+    evaluation is one pass over the default rule pack against the ring's
+    newest samples on the daemon loop — off the per-message hot path —
+    so the budget is the observability ≤3% on msgs_per_sec."""
+    off: list[float] = []
+    on: list[float] = []
+    for i in range(SMALL_RUNS):
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-alrt-") as tmp:
+            off.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={
+                        "DORA_METRICS_HISTORY_S": "0.5",
+                        "DORA_ALERTS": "0",
+                    },
+                )["msgs_per_sec"]
+            )
+        with tempfile.TemporaryDirectory(prefix="dora-tpu-alrt-") as tmp:
+            on.append(
+                small_message_run(
+                    Path(tmp), "daemon",
+                    extra_env={
+                        "DORA_METRICS_HISTORY_S": "0.5",
+                        "DORA_ALERTS": "1",
+                    },
+                )["msgs_per_sec"]
+            )
+        print(
+            f"# alerts A/B run {i + 1}/{SMALL_RUNS}: "
             f"off {off[-1]:.0f} msg/s, on {on[-1]:.0f} msg/s",
             file=sys.stderr,
         )
@@ -1012,6 +1058,16 @@ def main() -> int:
         }
 
     try:
+        alerts_ab = alerts_ab_leg()
+    except Exception as exc:
+        alerts_ab = {
+            "off_msgs_per_sec": None,
+            "on_msgs_per_sec": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         engine_ab = serving_engine_ab()
     except Exception as exc:
         engine_ab = {
@@ -1132,6 +1188,7 @@ def main() -> int:
         "tracing_ab": tracing_ab,
         "lockcheck_ab": lockcheck_ab,
         "history_prom_ab": history_prom_ab,
+        "alerts_ab": alerts_ab,
         "serving_engine_ab": engine_ab,
         "serving_multistep_ab": multistep_ab,
         "serving_trace_ab": trace_ab,

@@ -55,6 +55,11 @@ REGISTRY: dict[str, EnvVar] = dict((
     _e("DORA_NO_STACK_DUMP", "bool", "0", "suppress SIGUSR1 stack dumps"),
     _e("DORA_METRICS_HISTORY_S", "float", "900", "metrics history window seconds", True),
     _e("DORA_METRICS_HISTORY_LEN", "int", "1800", "metrics history ring length", True),
+    _e("DORA_ALERTS", "bool", "1", "evaluate alert rules over the metrics history", True),
+    _e("DORA_ALERT_SINK", "str", "", "comma list of alert sinks: log, jsonl, webhook", True),
+    _e("DORA_ALERT_SINK_FILE", "path", "", "JSONL alert sink output file", True),
+    _e("DORA_ALERT_SINK_WEBHOOK", "str", "", "webhook alert sink POST URL", True),
+    _e("DORA_ALERT_WEBHOOK_RETRIES", "int", "2", "extra webhook delivery attempts per alert", True),
     _e("DORA_PROM_PORT", "int", "", "coordinator Prometheus exporter port", True),
     _e("DORA_DEVICE_MONITOR", "bool", "1", "sample HBM/MFU device gauges", True),
     _e("DORA_DEVICE_PEAK_FLOPS", "float", "", "override device peak FLOP/s for MFU", True),

@@ -80,10 +80,12 @@ def cmd_check(args) -> int:
     import json
 
     from dora_tpu.analysis import errors as _errors
+    from dora_tpu.analysis.alertcheck import check_alerts
     from dora_tpu.analysis.graphcheck import check_descriptor
 
     descriptor = _read_descriptor(args.dataflow)
     findings = check_descriptor(descriptor, Path(args.dataflow).parent)
+    findings += check_alerts(descriptor)
     if getattr(args, "json", False):
         print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:
@@ -121,6 +123,15 @@ def cmd_lint(args) -> int:
         findings += envreg.lint(pkg_root, repo_root / "README.md")
         findings += wirecheck.lint(repo_root)
         findings += lint_lock_wiring(pkg_root)
+        # Default alert pack + sink env: a pack rule naming a renamed
+        # series key is a bug in this repo, not in a user descriptor.
+        from dora_tpu.analysis.alertcheck import check_alerts
+        from dora_tpu.core.descriptor import Descriptor
+
+        pack_holder = Descriptor.parse(
+            {"nodes": [{"id": "_lint", "path": "noop.py"}]}
+        )
+        findings += check_alerts(pack_holder)
     if args.json:
         print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:
@@ -506,6 +517,33 @@ def cmd_top(args) -> int:
             time.sleep(args.interval)
 
 
+def cmd_alerts(args) -> int:
+    """Current alert status of a dataflow: per-rule instance states from
+    the daemon-side engines, merged by the coordinator (archived
+    dataflows included — a post-mortem still shows what fired)."""
+    import json
+
+    from dora_tpu.cli.alerts_view import render_alerts
+
+    with _control(args) as c:
+        while True:
+            reply = c.request(
+                cm.QueryAlerts(dataflow_uuid=args.uuid, name=args.name)
+            )
+            if isinstance(reply, cm.Error):
+                print(reply.message, file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(reply.alerts, indent=2, sort_keys=True))
+                return 0
+            text = render_alerts(reply.dataflow_uuid, reply.alerts)
+            if not args.watch:
+                print(text, end="")
+                return 0
+            print("\x1b[2J\x1b[H" + text, end="", flush=True)
+            time.sleep(args.interval)
+
+
 def cmd_trace(args) -> int:
     """Export a dataflow's merged, clock-aligned message timeline as
     Chrome trace JSON (load in Perfetto / chrome://tracing). ``--check``
@@ -599,7 +637,24 @@ def cmd_profile(args) -> int:
 def cmd_logs(args) -> int:
     with _control(args) as c:
         reply = c.request(cm.Logs(uuid=args.uuid, name=args.name, node=args.node))
-        sys.stdout.write(reply.logs.decode(errors="replace"))
+        text = reply.logs.decode(errors="replace")
+        if getattr(args, "level", None):
+            from dora_tpu.message.common import (
+                log_level_at_least,
+                parse_level_prefix,
+            )
+
+            # Same classifier the daemon's log pump uses; lines without
+            # a recognizable prefix count as "info" here (the pump's
+            # stderr default isn't knowable from the merged file).
+            text = "".join(
+                line + "\n"
+                for line in text.splitlines()
+                if log_level_at_least(
+                    parse_level_prefix(line) or "info", args.level
+                )
+            )
+        sys.stdout.write(text)
     return 0
 
 
@@ -779,6 +834,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser(
+        "alerts",
+        help="show a dataflow's alert status (pending/firing per rule)",
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument(
+        "--watch", action="store_true", help="refresh top-style"
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, help="--watch refresh seconds"
+    )
+    p.add_argument(
+        "--json", action="store_true", help="print the raw merged status"
+    )
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
         "trace",
         help="export a dataflow's message timeline (Chrome trace / Perfetto)",
     )
@@ -831,6 +904,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("node")
     p.add_argument("--uuid", default=None)
     p.add_argument("--name", default=None)
+    p.add_argument(
+        "--level", default=None,
+        choices=["trace", "debug", "info", "warn", "error"],
+        help="only lines at or above this level (level-prefix parsed)",
+    )
     coordinator_addr(p)
     p.set_defaults(fn=cmd_logs)
 

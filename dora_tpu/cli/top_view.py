@@ -245,4 +245,13 @@ def render_top(uuid: str, snap: dict, history: dict) -> str:
              "TIMELINE", "LAST"],
             slo_rows,
         )
+
+    # ALERTS: active (pending/firing) instances from the merged
+    # snapshot's alerts block — evaluated daemon-side by the alert
+    # engine, so this panel agrees with `dora-tpu alerts` and prom.
+    alerts = snap.get("alerts") or {}
+    if alerts.get("rules"):
+        from dora_tpu.cli.alerts_view import render_alerts_panel
+
+        lines += render_alerts_panel(alerts)
     return "\n".join(lines).rstrip() + "\n"

@@ -93,6 +93,7 @@ class Coordinator:
         self._metrics_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._trace_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._history_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        self._alerts_waiters: dict[tuple[str, str], asyncio.Future] = {}
         #: correlation for deep-capture requests: (dataflow_id, node_id)
         #: -> future resolved by ProfileReplyFromDaemon
         self._profile_waiters: dict[tuple[str, str], asyncio.Future] = {}
@@ -266,6 +267,12 @@ class Coordinator:
             )
             if fut is not None and not fut.done():
                 fut.set_result(event.history)
+        elif isinstance(event, cm.AlertsReplyFromDaemon):
+            fut = self._alerts_waiters.get(
+                (event.dataflow_id, event.machine_id)
+            )
+            if fut is not None and not fut.done():
+                fut.set_result(event.alerts)
         elif isinstance(event, cm.ProfileReplyFromDaemon):
             fut = self._profile_waiters.get(
                 (event.dataflow_id, event.node_id)
@@ -512,6 +519,38 @@ class Coordinator:
                 self._history_waiters.pop((uuid, machine), None)
         return merge_history_snapshots(
             [s for s in snapshots if isinstance(s, dict)]
+        )
+
+    async def request_alerts(self, uuid: str) -> dict:
+        """Fan an AlertsRequest out to every involved daemon and union
+        the per-machine alert statuses (dora_tpu.alerts.merge_alert_status
+        — instances keep their machine-qualified keys, counters sum).
+        Works for archived dataflows too — daemons keep finished dataflow
+        state, alert engine included, so a post-mortem `dora-tpu alerts`
+        still shows what fired."""
+        from dora_tpu.alerts import merge_alert_status
+
+        df = self.running.get(uuid)
+        if df is None and uuid in self.archived:
+            df = self.archived[uuid][0]
+        if df is None:
+            raise KeyError(f"unknown dataflow {uuid!r}")
+        loop = asyncio.get_running_loop()
+        futs = []
+        for machine in sorted(df.machines):
+            fut = loop.create_future()
+            self._alerts_waiters[(uuid, machine)] = fut
+            self._daemon_send(machine, cm.AlertsRequest(dataflow_id=uuid))
+            futs.append(fut)
+        try:
+            statuses = await asyncio.wait_for(
+                asyncio.gather(*futs, return_exceptions=True), timeout=10
+            )
+        finally:
+            for machine in df.machines:
+                self._alerts_waiters.pop((uuid, machine), None)
+        return merge_alert_status(
+            [s for s in statuses if isinstance(s, dict) and s]
         )
 
     async def request_trace(self, uuid: str) -> dict:
@@ -817,6 +856,12 @@ class Coordinator:
                 return uuid
             history = await self.request_metrics_history(uuid)
             return cm.MetricsHistoryReply(dataflow_uuid=uuid, history=history)
+        if isinstance(request, cm.QueryAlerts):
+            uuid = self._query_target(request.dataflow_uuid, request.name)
+            if isinstance(uuid, cm.Error):
+                return uuid
+            alerts = await self.request_alerts(uuid)
+            return cm.AlertsReply(dataflow_uuid=uuid, alerts=alerts)
         if isinstance(request, cm.QueryTrace):
             uuid = self._query_target(request.dataflow_uuid, request.name)
             if isinstance(uuid, cm.Error):

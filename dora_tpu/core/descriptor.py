@@ -503,6 +503,7 @@ class Descriptor:
 
     nodes: tuple[ResolvedNode, ...]
     communication: CommunicationConfig = field(default_factory=CommunicationConfig)
+    alerts: "AlertsPolicy | None" = None
     raw: dict[str, Any] = field(default_factory=dict, compare=False)
 
     # -- constructors -------------------------------------------------------
@@ -517,7 +518,7 @@ class Descriptor:
     def parse(cls, raw: Mapping[str, Any]) -> "Descriptor":
         if not isinstance(raw, Mapping):
             raise ValueError("dataflow descriptor must be a YAML mapping")
-        known = {"nodes", "communication", "deploy", "_unstable_deploy", "env"}
+        known = {"nodes", "communication", "deploy", "_unstable_deploy", "env", "alerts"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown top-level keys: {sorted(unknown)}")
@@ -534,9 +535,18 @@ class Descriptor:
         dupes = {i for i in ids if ids.count(i) > 1}
         if dupes:
             raise ValueError(f"duplicate node ids: {sorted(dupes)}")
+        # Lazy import: alerts.py pulls in metrics/metrics_history, which
+        # descriptor consumers (schema generation, node CLIs) don't need
+        # unless the descriptor actually carries an alerts: block.
+        alerts = None
+        if raw.get("alerts") is not None:
+            from dora_tpu.alerts import AlertsPolicy
+
+            alerts = AlertsPolicy.parse(raw.get("alerts"))
         return cls(
             nodes=nodes,
             communication=CommunicationConfig.parse(raw.get("communication")),
+            alerts=alerts,
             raw=dict(raw),
         )
 

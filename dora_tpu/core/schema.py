@@ -186,6 +186,69 @@ _QOS = {
     "additionalProperties": False,
 }
 
+_ALERT_RULE = {
+    "description": (
+        "One declarative alert rule evaluated against the metrics "
+        "history ring: a windowed predicate over matching series, with "
+        "for-duration and firing-side hysteresis."
+    ),
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "minLength": 1},
+        "kind": {
+            "type": "string",
+            "enum": ["gauge", "rate", "ratio", "gauge_ratio",
+                     "percentile", "burn"],
+        },
+        "selector": {
+            "type": "string",
+            "minLength": 1,
+            "description": (
+                "Flat series key (flatten_snapshot naming, e.g. "
+                "'srv:*:shed', 'queue:*') with at most one '*'"
+            ),
+        },
+        "op": {"type": "string", "enum": [">", ">=", "<", "<="]},
+        "threshold": {"type": "number"},
+        "for_s": {"type": "number", "minimum": 0},
+        "clear_s": {"type": "number", "minimum": 0},
+        "resolve_threshold": {"type": "number"},
+        "severity": {
+            "type": "string",
+            "enum": ["info", "warning", "critical"],
+        },
+        "window_s": {"type": "number", "exclusiveMinimum": 0},
+        "percentile": {"type": "number", "minimum": 0, "maximum": 100},
+        "denominator": {"type": "string", "minLength": 1},
+        "min_rate": {"type": "number", "minimum": 0},
+        "labels": {
+            "type": "object",
+            "additionalProperties": {"type": "string"},
+        },
+    },
+    "required": ["name", "kind", "selector", "op", "threshold"],
+    "additionalProperties": False,
+}
+
+_ALERTS = {
+    "description": (
+        "Alerting plane: extra rules merged over the built-in default "
+        "pack (same-name overrides), plus pack rules disabled by name."
+    ),
+    "type": "object",
+    "properties": {
+        "rules": {
+            "type": "array",
+            "items": {"$ref": "#/definitions/alert_rule"},
+        },
+        "disable": {
+            "type": "array",
+            "items": {"type": "string", "minLength": 1},
+        },
+    },
+    "additionalProperties": False,
+}
+
 _NODE = {
     "type": "object",
     "properties": {
@@ -280,6 +343,7 @@ def descriptor_schema() -> dict[str, Any]:
             "deploy": {"$ref": "#/definitions/deploy"},
             "_unstable_deploy": {"$ref": "#/definitions/deploy"},
             "env": {"$ref": "#/definitions/env"},
+            "alerts": {"$ref": "#/definitions/alerts"},
         },
         "required": ["nodes"],
         "additionalProperties": False,
@@ -295,6 +359,8 @@ def descriptor_schema() -> dict[str, Any]:
             "restart": _RESTART,
             "slo": _SLO,
             "qos": _QOS,
+            "alerts": _ALERTS,
+            "alert_rule": _ALERT_RULE,
             "communication": _COMMUNICATION,
         },
     }

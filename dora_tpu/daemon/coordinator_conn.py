@@ -323,6 +323,17 @@ async def _serve_connection(
                         ),
                     )
                 )
+            elif isinstance(event, cm.AlertsRequest):
+                df = daemon.dataflows.get(event.dataflow_id)
+                outbox.put_nowait(
+                    cm.AlertsReplyFromDaemon(
+                        dataflow_id=event.dataflow_id,
+                        machine_id=machine_id,
+                        alerts=(
+                            daemon.alerts_snapshot(df) if df is not None else {}
+                        ),
+                    )
+                )
             elif isinstance(event, cm.DestroyDaemon):
                 return True
             else:

@@ -51,6 +51,7 @@ from dora_tpu.message.common import (
     ENCODING_RAW,
 )
 from dora_tpu.message import fastroute
+from dora_tpu.alerts import AlertEngine, engine_for
 from dora_tpu.metrics import DataflowMetrics
 from dora_tpu.metrics_history import MetricsHistoryRing, history_interval_s
 from dora_tpu.telemetry import FLIGHT, OTEL_CTX_KEY, TRACING
@@ -198,6 +199,14 @@ class DataflowState:
     history: MetricsHistoryRing | None = None
     #: the sampler task feeding ``history`` (cancelled on finish)
     history_task: asyncio.Task | None = None
+    #: alerting plane: rules engine evaluated on the sampler tick over
+    #: ``history`` (dora_tpu.alerts; None when history is off or
+    #: DORA_ALERTS=0). Retained after finish like the ring, so
+    #: QueryAlerts covers archived runs.
+    alerts: AlertEngine | None = None
+    #: structured log severity: node id -> [error lines, warn lines]
+    #: counted by on_node_log from the parsed level prefixes
+    log_counts: dict[str, list[int]] = field(default_factory=dict)
 
     def node_machine(self, node_id: str) -> str:
         return self.descriptor.node(node_id).deploy.machine or ""
@@ -346,6 +355,11 @@ class Daemon:
             df.history = MetricsHistoryRing(
                 interval_s=interval, slo_targets=slo_targets
             )
+            # Alert engine rides the same cadence: default rule pack
+            # merged under the descriptor's ``alerts:`` block, sinks
+            # from DORA_ALERT_SINK (dora_tpu.alerts; DORA_ALERTS=0
+            # disables evaluation while keeping the ring).
+            df.alerts = engine_for(descriptor.alerts, interval_s=interval)
             df.history_task = asyncio.create_task(self._history_sampler(df))
 
         # Routing tables (reference: daemon/src/lib.rs:628-660).
@@ -764,6 +778,15 @@ class Daemon:
             }
         if df.history is not None and df.history.slo_targets:
             snap["slo"] = df.history.slo_status()
+        if df.log_counts:
+            snap["logs"] = {
+                nid: {"errors": c[0], "warns": c[1]}
+                for nid, c in df.log_counts.items()
+            }
+        if df.node_trace_drops:
+            snap["trace"] = {"drops": dict(df.node_trace_drops)}
+        if df.alerts is not None:
+            snap["alerts"] = df.alerts.status()
         return snap
 
     async def _history_sampler(self, df: DataflowState) -> None:
@@ -783,13 +806,25 @@ class Daemon:
         if df.history is None:
             return
         snap = self.metrics_snapshot(df)
+        wall_ns = time.time_ns()
         hlc_ns = self.clock.new_timestamp().physical_ns
-        events = df.history.sample(snap, time.time_ns(), hlc_ns)
+        events = df.history.sample(snap, wall_ns, hlc_ns)
         for node, objective, observed, target in events:
             FLIGHT.record(
                 "slo_violation", f"{node}:{objective}",
                 f"observed={observed} target={target}", None,
             )
+        # Alert evaluation rides the sampler tick: transitions become
+        # flight instants on this daemon's trace track (and fan out to
+        # the configured sinks inside the engine).
+        if df.alerts is not None:
+            for ev in df.alerts.evaluate_ring(df.history, wall_ns):
+                FLIGHT.record(
+                    f"alert_{ev['phase']}",
+                    f"{ev['rule']}:{ev['instance']}",
+                    f"value={ev['value']} threshold={ev['threshold']}",
+                    None,
+                )
 
     def history_snapshot(self, df: DataflowState) -> dict:
         """Per-machine history-ring snapshot — the payload of a
@@ -804,6 +839,17 @@ class Daemon:
         out["machine_id"] = self.machine_id
         out["hlc_ns"] = self.clock.new_timestamp().physical_ns
         out["wall_ns"] = time.time_ns()
+        return out
+
+    def alerts_snapshot(self, df: DataflowState) -> dict:
+        """Per-machine alert-engine status — the payload of an
+        AlertsRequest reply. No clock alignment needed (states, not
+        samples); the machine id lets the coordinator's merge attribute
+        instances."""
+        if df.alerts is None:
+            return {}
+        out = df.alerts.status()
+        out["machine_id"] = self.machine_id
         return out
 
     def trace_snapshot(self, df: DataflowState) -> dict:
@@ -1304,6 +1350,13 @@ class Daemon:
     # ------------------------------------------------------------------
 
     def on_node_log(self, df: DataflowState, node_id: str, level: str, text: str) -> None:
+        # Structured severity: feed the per-node error/warn counters the
+        # metrics plane exports (prom, history series, log-errors alert).
+        if level in ("error", "warn"):
+            counts = df.log_counts.get(node_id)
+            if counts is None:
+                counts = df.log_counts[node_id] = [0, 0]
+            counts[0 if level == "error" else 1] += 1
         if self.log_sink is not None:
             from dora_tpu.message.common import LogMessage
 

@@ -24,6 +24,7 @@ from dora_tpu.core.descriptor import (
     ResolvedNode,
     RuntimeNode,
 )
+from dora_tpu.message.common import parse_level_prefix
 from dora_tpu.message.daemon_to_node import NodeConfig
 from dora_tpu.message.serde import decode, encode
 
@@ -181,7 +182,15 @@ async def _pump_stream(daemon, df, node, stream, log_file, *, is_stderr: bool):
             ring = df.stderr_rings.setdefault(str(node.id), [])
             ring.append(text)
             del ring[:-STDERR_RING_LINES]
-        daemon.on_node_log(df, str(node.id), "error" if is_stderr else "info", text)
+        # Structured severity: a recognizable level prefix on the line
+        # wins over the stream-based default (stderr is where Python
+        # logging sends EVERYTHING, so "stderr == error" over-counted;
+        # conversely an `ERROR:` line on stdout was invisible). Feeds
+        # the per-node log_errors/log_warns counters and `logs --level`.
+        level = parse_level_prefix(text)
+        if level is None:
+            level = "error" if is_stderr else "info"
+        daemon.on_node_log(df, str(node.id), level, text)
         if not is_stderr and send_as:
             daemon.publish_stdout_line(df, node.id, send_as, text)
 

@@ -192,3 +192,36 @@ def log_level_at_least(level: str, minimum: str) -> bool:
         return LOG_LEVELS.index(level) >= LOG_LEVELS.index(minimum)
     except ValueError:
         return True
+
+
+#: Level-word spellings accepted by parse_level_prefix -> canonical level.
+_LEVEL_WORDS = {
+    "trace": "trace",
+    "debug": "debug",
+    "info": "info",
+    "warn": "warn",
+    "warning": "warn",
+    "error": "error",
+    "err": "error",
+    "critical": "error",
+    "fatal": "error",
+}
+
+
+def parse_level_prefix(text: str) -> str | None:
+    """Best-effort severity from a log line's leading tokens.
+
+    Accepts the common prefix shapes — ``ERROR: boom``, ``[warn] slow``,
+    ``2026-01-01 00:00:00,123 WARNING retrying`` — by checking the first
+    few whitespace tokens (stripped of bracket/colon punctuation)
+    against the level vocabulary, case-insensitively. Returns the
+    canonical ``LOG_LEVELS`` name or None when no prefix is
+    recognizable; callers keep their stream-based default then."""
+    for token in text.split(None, 3)[:3]:
+        word = token.strip("[]()<>:,-|").lower()
+        if len(word) < 3:
+            continue
+        level = _LEVEL_WORDS.get(word)
+        if level is not None:
+            return level
+    return None

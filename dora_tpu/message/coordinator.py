@@ -100,6 +100,16 @@ class QueryMetricsHistory:
 
 
 @message
+class QueryAlerts:
+    """Fetch the merged alert status (pending/firing instances per rule)
+    of a dataflow (running or finished). Resolution mirrors
+    QueryMetrics."""
+
+    dataflow_uuid: str | None = None
+    name: str | None = None
+
+
+@message
 class MigrateNode:
     """Drain a serving node's live KV streams at a window boundary and
     re-admit them on another engine: the node quiesces, serializes its
@@ -237,6 +247,12 @@ class MetricsHistoryReply:
 
 
 @message
+class AlertsReply:
+    dataflow_uuid: str
+    alerts: dict[str, Any]  # merged status (dora_tpu.alerts.AlertEngine.status)
+
+
+@message
 class DaemonConnectedReply:
     connected: bool
 
@@ -331,6 +347,11 @@ class MetricsHistoryRequest:
 
 
 @message
+class AlertsRequest:
+    dataflow_id: str
+
+
+@message
 class Heartbeat:
     pass
 
@@ -411,6 +432,13 @@ class MetricsHistoryReplyFromDaemon:
     dataflow_id: str
     machine_id: str
     history: dict[str, Any]  # per-machine ring (Daemon.history_snapshot)
+
+
+@message
+class AlertsReplyFromDaemon:
+    dataflow_id: str
+    machine_id: str
+    alerts: dict[str, Any]  # per-machine status (Daemon.alerts_snapshot)
 
 
 @message

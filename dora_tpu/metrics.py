@@ -195,6 +195,7 @@ class ServingMetrics:
         "kv_dtype", "kv_pool_bytes", "kv_quant_err",
         "lora_resident", "lora_max_resident", "lora_resident_bytes",
         "lora_loads", "lora_evictions", "adapter_streams",
+        "adapter_stalls",
     )
 
     def __init__(self, engine: str = "dense"):
@@ -332,6 +333,13 @@ class ServingMetrics:
         self.lora_loads = 0
         self.lora_evictions = 0
         self.adapter_streams: dict[str, int] = {}
+        #: backlog entries shed (or admitted late) because the N+1-th
+        #: tenant's adapter could not evict — every resident adapter
+        #: pinned by a live stream. Split from plain queue overload so
+        #: the two are distinguishable (KNOWN_ISSUES round 19); the
+        #: wire chunk carries the same attribution as
+        #: ``stall_reason="adapter_residency"``.
+        self.adapter_stalls = 0
 
     def snapshot(self) -> dict:
         import time
@@ -423,6 +431,7 @@ class ServingMetrics:
             "lora_loads": self.lora_loads,
             "lora_evictions": self.lora_evictions,
             "adapter_streams": dict(self.adapter_streams),
+            "adapter_stalls": self.adapter_stalls,
         }
 
 
@@ -442,14 +451,24 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
     respawns: dict[str, int] = {}
     replayed: dict[str, int] = {}
     slo: dict[str, dict] = {}
+    logs: dict[str, dict] = {}
+    trace_drops: dict[str, int] = {}
+    alert_statuses: list[dict] = []
     for snap in snapshots:
         if not snap:
             continue
         # Each serving node lives on exactly one machine: union. Same
         # for the SLO burn block — objectives attach to a node, and the
-        # node's daemon evaluates them against its own history ring.
+        # node's daemon evaluates them against its own history ring —
+        # and the per-node log counters. Alert engines run per daemon;
+        # their statuses merge instance-wise (dora_tpu.alerts).
         serving.update(snap.get("serving", {}))
         slo.update(snap.get("slo", {}))
+        logs.update(snap.get("logs", {}))
+        for node, c in (snap.get("trace") or {}).get("drops", {}).items():
+            trace_drops[node] = trace_drops.get(node, 0) + c
+        if snap.get("alerts"):
+            alert_statuses.append(snap["alerts"])
         recovery = snap.get("recovery") or {}
         for key, c in recovery.get("respawns", {}).items():
             respawns[key] = respawns.get(key, 0) + c
@@ -496,6 +515,14 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
         out["serving"] = serving
     if slo:
         out["slo"] = slo
+    if logs:
+        out["logs"] = logs
+    if trace_drops:
+        out["trace"] = {"drops": trace_drops}
+    if alert_statuses:
+        from dora_tpu.alerts import merge_alert_status
+
+        out["alerts"] = merge_alert_status(alert_statuses)
     if respawns or replayed:
         out["recovery"] = {
             "respawns": respawns,

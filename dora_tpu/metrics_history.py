@@ -103,6 +103,15 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
         gauges[f"queue:{key}"] = d
     for key, h in snap.get("latency_us", {}).items():
         hists[f"lat:{key}"] = list(h.get("counts", []))
+    # Structured log severity: per-node stderr/stdout ERROR and WARN
+    # line counts (daemon-side parse; alerting's log-error-rate rule).
+    for node, c in snap.get("logs", {}).items():
+        counters[f"logerr:{node}"] = c.get("errors", 0)
+        counters[f"logwarn:{node}"] = c.get("warns", 0)
+    # Trace-plane truncation: node events the daemon-side buffer cap
+    # trimmed (the trace-truncated alert watches this rate).
+    for node, c in (snap.get("trace") or {}).get("drops", {}).items():
+        counters[f"tracedrop:{node}"] = c
     for node, s in snap.get("serving", {}).items():
         for name in ("decode_tokens", "requests", "rejected",
                      "prefill_chunks", "host_dispatches", "compiles",
@@ -112,7 +121,8 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
                      "prefix_cow_copies", "prefix_evictions",
                      "device_compute_ns", "host_dispatch_ns",
                      "device_fetch_ns", "dispatched_flops",
-                     "useful_flops", "lora_loads", "lora_evictions"):
+                     "useful_flops", "lora_loads", "lora_evictions",
+                     "adapter_stalls"):
             counters[f"srv:{node}:{name}"] = s.get(name, 0)
         for name in ("slots_active", "slots_total", "used_pages",
                      "total_pages", "free_pages", "backlog_depth",
@@ -123,9 +133,12 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
         # Device utilization gauges are None when unknown (CPU backend,
         # monitor off, pre-round-16 snapshot): recorded only when real,
         # so history series never fabricate a zero-MFU sample.
+        # checkpoint_age_s rides along: derived (non-monotonic) but a
+        # gauge like the rest, None until the first checkpoint lands —
+        # the checkpoint-stale alert reads it from here.
         for name in ("mfu", "device_busy_fraction", "hbm_used_bytes",
                      "hbm_limit_bytes", "hbm_peak_bytes",
-                     "kv_pool_bytes", "kv_quant_err"):
+                     "kv_pool_bytes", "kv_quant_err", "checkpoint_age_s"):
             if s.get(name) is not None:
                 gauges[f"srv:{node}:{name}"] = s[name]
         # kv_dtype is a string gauge; series store its 0/1 projection

@@ -732,6 +732,27 @@ class PagedBatchEngine:
             and self.pages_needed(prompt_len, max_new) <= avail
         )
 
+    def admit_blocker(self, prompt_len: int, max_new: int,
+                      adapter: str | None = None) -> str | None:
+        """Why :meth:`can_admit` says no — stall attribution for the
+        admission queue. ``"adapter_residency"`` singles out the
+        multi-tenant case where everything else admits but the N+1-th
+        tenant's adapter cannot evict a pinned resident (KNOWN_ISSUES
+        round 19: this used to be indistinguishable from plain
+        overload in the shed counters); ``"capacity"`` covers slots /
+        pages / length, ``None`` means admissible."""
+        if self.can_admit(prompt_len, max_new, adapter):
+            return None
+        if (
+            adapter
+            and self.lora is not None
+            and self.lora.has(adapter)
+            and not self.lora.fits(adapter)
+            and self.can_admit(prompt_len, max_new, None)
+        ):
+            return "adapter_residency"
+        return "capacity"
+
     def submit(self, request_id: str, prompt_ids, max_new: int,
                adapter: str | None = None) -> None:
         """Admit a stream: grant its pages, write its block table and

@@ -224,7 +224,8 @@ class AdmissionQueue:
     span from it."""
 
     def __init__(self, engine, start, on_admit=None, clock=time.monotonic,
-                 qos: QosConfig | None = None, on_shed=None, preempt=None):
+                 qos: QosConfig | None = None, on_shed=None, preempt=None,
+                 on_stall=None):
         self._engine = engine
         self._start = start
         self._on_admit = on_admit
@@ -232,6 +233,11 @@ class AdmissionQueue:
         self._qos = qos or QosConfig()
         self._on_shed = on_shed
         self._preempt = preempt
+        self._on_stall = on_stall
+        #: key -> why its head-of-class admission is blocked
+        #: (engine.admit_blocker); set once per parking episode so
+        #: ``on_stall`` fires once, cleared on admit/shed.
+        self._stall_reasons: dict[str, str] = {}
         #: class -> [[key, ids, max_new, t_in, deadline_s, adapter], ...]
         #: FIFO. ``adapter`` is the stream's LoRA tenant (None = base);
         #: it parks with the request and rides admission into
@@ -250,6 +256,12 @@ class AdmissionQueue:
         return any(
             entry[0] == key for q in self._q.values() for entry in q
         )
+
+    def stall_reason(self, key: str) -> str | None:
+        """Why ``key``'s current parking episode is blocked (None when
+        it never reached the head while inadmissible). Valid inside the
+        on_admit/on_shed callbacks — cleared right after."""
+        return self._stall_reasons.get(key)
 
     def push(self, key: str, ids: list[int], max_new: int,
              qos: str | None = None, deadline_s: float | None = None,
@@ -293,6 +305,7 @@ class AdmissionQueue:
                 waited = now - entry[3]
                 if limit is not None and waited > limit:
                     self._on_shed(entry[0], "queue_wait", waited)
+                    self._stall_reasons.pop(entry[0], None)
                 else:
                     kept.append(entry)
             q[:] = kept
@@ -329,10 +342,26 @@ class AdmissionQueue:
             if not admissible:
                 if self._preempt is not None and self._preempt(cls):
                     continue  # a victim was evicted: re-score and retry
+                # Attribute the stall: "adapter_residency" means
+                # everything else admits but the tenant's adapter
+                # cannot evict a pinned resident — without this tag it
+                # reads as plain overload. Re-evaluated every drain
+                # (a capacity stall can become adapter-gated as pages
+                # free), but on_stall fires only on transitions.
+                blocker = getattr(self._engine, "admit_blocker", None)
+                reason = (
+                    blocker(len(ids), max_new, adapter)
+                    if blocker is not None else "capacity"
+                ) or "capacity"
+                if self._stall_reasons.get(key) != reason:
+                    self._stall_reasons[key] = reason
+                    if self._on_stall is not None:
+                        self._on_stall(key, reason)
                 return
             self._q[cls].pop(0)
             if self._on_admit is not None:
                 self._on_admit(key, now - t_in)
+            self._stall_reasons.pop(key, None)
             # Same compatibility split as can_admit: pre-adapter start
             # callbacks take exactly (key, ids, max_new).
             if adapter:
@@ -356,6 +385,7 @@ class AdmissionQueue:
         out = self.pending()
         for q in self._q.values():
             q.clear()
+        self._stall_reasons.clear()
         return out
 
 
@@ -547,6 +577,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         req_prompt.pop(key, None)
         req_emitted.pop(key, None)
         admit_seq.pop(key, None)
+        stall_tags.pop(key, None)
         preempted_keys.discard(key)
         pinned = pinned_prefix.pop(key, None)
         if pinned is not None and hasattr(engine, "prefix_unpin"):
@@ -568,6 +599,9 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             meta["finish"] = finish or "stop"
         if extra:
             meta.update(extra)
+        stalled = stall_tags.pop(key, None)
+        if stalled is not None and "stall_reason" not in meta:
+            meta["stall_reason"] = stalled
         seq = seqs.get(key, 0)
         meta["seq"] = seq
         if done:
@@ -604,8 +638,21 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             req_emitted[key].append(token)
         emit_text(key, decode_one(token), done, finish)
 
+    #: keys whose backlog wait was attributed to adapter residency —
+    #: the next wire chunk (first token or shed) carries the tag so the
+    #: client can tell "tenant blocked" from plain overload.
+    stall_tags: dict[str, str] = {}
+
+    def on_stall(key: str, reason: str) -> None:
+        if reason == "adapter_residency":
+            metrics.adapter_stalls += 1
+            tracer.instant("s_page_wait", key, "adapter_residency")
+
     def on_admit(key: str, waited_s: float) -> None:
         metrics.backlog_wait.observe(waited_s * 1e6)
+        reason = backlog.stall_reason(key)
+        if reason == "adapter_residency":
+            stall_tags[key] = reason
         # The queued span closes at admission; the exporter derives its
         # start from the duration, so it covers the whole backlog wait.
         tracer.span("s_queued", key, dur_ns=int(waited_s * 1e9))
@@ -644,10 +691,10 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         t_admitted.pop(key, None)  # a shed stream has no first token
         tracer.instant("s_shed", key, f"{reason} waited={waited_s:.3f}s")
         retry_ms = int(max(100.0, (qos.shed_wait_s or 1.0) * 1000.0))
-        emit_text(
-            key, "", True, finish="overloaded",
-            extra={"retry_after_ms": retry_ms},
-        )
+        extra = {"retry_after_ms": retry_ms}
+        if backlog.stall_reason(key) == "adapter_residency":
+            extra["stall_reason"] = "adapter_residency"
+        emit_text(key, "", True, finish="overloaded", extra=extra)
 
     def try_preempt(cls: str) -> bool:
         """A ``cls`` head is blocked on capacity: evict ONE victim of a
@@ -703,6 +750,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
         engine, start, on_admit=on_admit, clock=clock,
         qos=qos, on_shed=on_shed,
         preempt=try_preempt if can_preempt else None,
+        on_stall=on_stall,
     )
 
     def handle_input(event) -> None:

@@ -85,6 +85,13 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_serving_lora_loads_total": ("counter", "LoRA adapters loaded into the resident pool"),
     "dora_serving_lora_evictions_total": ("counter", "LoRA adapters evicted from the resident pool (LRU)"),
     "dora_serving_adapter_streams": ("gauge", "Live streams pinned per resident LoRA adapter"),
+    "dora_serving_adapter_stalls_total": ("counter", "Backlog entries parked because the requested LoRA adapter cannot become resident"),
+    "dora_node_log_errors_total": ("counter", "Error-level log lines per node (level-prefix parsed)"),
+    "dora_node_log_warns_total": ("counter", "Warn-level log lines per node (level-prefix parsed)"),
+    "dora_trace_dropped_events_total": ("counter", "Flight-recorder events lost to ring truncation per process"),
+    "dora_alerts": ("gauge", "Active alert instances: 1 per (alertname, instance) in state pending or firing"),
+    "dora_alert_firing_total": ("counter", "Pending-to-firing transitions per alert rule"),
+    "dora_alert_resolved_total": ("counter", "Firing-to-resolved transitions per alert rule"),
 }
 
 #: (snapshot serving key, metric family) pairs for the per-node scalars
@@ -111,6 +118,7 @@ _SERVING_COUNTERS = (
     ("dispatched_flops", "dora_tpu_device_dispatched_flops_total"),
     ("lora_loads", "dora_serving_lora_loads_total"),
     ("lora_evictions", "dora_serving_lora_evictions_total"),
+    ("adapter_stalls", "dora_serving_adapter_stalls_total"),
 )
 _SERVING_GAUGES = (
     ("slots_active", "dora_serving_slots_active"),
@@ -219,6 +227,40 @@ def iter_samples(
                     1.0 if entry.get(f"burn_{window}_complete") else 0.0,
                 )
             yield "dora_slo_violations_total", labels, entry.get("violations", 0)
+        for node, counts in snap.get("logs", {}).items():
+            labels = {**base, "node": node}
+            yield "dora_node_log_errors_total", labels, counts.get("errors", 0)
+            yield "dora_node_log_warns_total", labels, counts.get("warns", 0)
+        for proc, c in (snap.get("trace") or {}).get("drops", {}).items():
+            yield (
+                "dora_trace_dropped_events_total",
+                {**base, "process": proc},
+                c,
+            )
+        alerts = snap.get("alerts") or {}
+        for name, entry in alerts.get("rules", {}).items():
+            for instance, inst in (entry.get("instances") or {}).items():
+                state = inst.get("state", "ok")
+                if state == "ok":
+                    # Only active series export — the Alertmanager
+                    # convention (absence means not firing); resolved
+                    # history lives in the _total counters below.
+                    continue
+                yield (
+                    "dora_alerts",
+                    {
+                        **base,
+                        "alertname": name,
+                        "instance": instance,
+                        "severity": entry.get("severity", "warning"),
+                        "alertstate": state,
+                    },
+                    1,
+                )
+        for name, c in alerts.get("firing_total", {}).items():
+            yield "dora_alert_firing_total", {**base, "alertname": name}, c
+        for name, c in alerts.get("resolved_total", {}).items():
+            yield "dora_alert_resolved_total", {**base, "alertname": name}, c
 
 
 def escape_label_value(value: str) -> str:
@@ -410,9 +452,53 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "lora_resident_bytes": 64 << 20,
                     "lora_loads": 9,
                     "lora_evictions": 7,
+                    "adapter_stalls": 3,
                     "adapter_streams": {"tenant-a": 2, 'b "quoted"': 1},
                     "ttft_us": hist.snapshot(),
                 }
+            },
+            "logs": {"llm": {"errors": 2, "warns": 5}},
+            "trace": {"drops": {"llm": 17}},
+            "alerts": {
+                "rules": {
+                    "queue-depth": {
+                        "severity": "warning",
+                        "labels": {"team": "serving"},
+                        "threshold": 256,
+                        "instances": {
+                            "plot/img": {
+                                "state": "firing",
+                                "value": 300.0,
+                                "since_unix": 1_700_000_000.0,
+                                "incidents": 1,
+                            },
+                            "cam/img": {
+                                "state": "ok",
+                                "value": 2.0,
+                                "since_unix": 1_700_000_100.0,
+                                "incidents": 0,
+                            },
+                        },
+                    },
+                    "shed-spike": {
+                        "severity": "critical",
+                        "labels": {},
+                        "threshold": 0.5,
+                        "instances": {
+                            "llm": {
+                                "state": "pending",
+                                "value": 0.8,
+                                "since_unix": 1_700_000_200.0,
+                                "incidents": 0,
+                            },
+                        },
+                    },
+                },
+                "firing": 1,
+                "pending": 1,
+                "transitions": {"pending": 2, "firing": 1, "resolved": 1},
+                "firing_total": {"queue-depth": 1},
+                "resolved_total": {"shed-spike": 1},
             },
             "slo": {
                 "llm": {

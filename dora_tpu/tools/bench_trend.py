@@ -47,6 +47,10 @@ WATCHED: dict[str, str] = {
     # drift toward 1.0 means cache hits stopped buying first-token
     # latency (the default-on gate is <= 0.5).
     "serving_prefix_ab.hit_p50_on_vs_off": "lower",
+    # Alerting-plane A/B: msgs/sec overhead of the default rule pack
+    # evaluating each history tick vs engine off — a drift upward means
+    # rule evaluation crept onto the budget (the gate is <= 3%).
+    "alerts_ab.overhead_pct": "lower",
     # Device-monitor A/B: wall-clock with the utilization plane on vs
     # off — a drift upward means the default-on monitor got expensive
     # (the gate is <= 3%).

@@ -102,6 +102,9 @@ INSTANT_NAMES = {
     "slo_violation": "SLO violation",
     "s_shed": "load shed",
     "k_retune": "window retune",
+    "alert_pending": "alert pending",
+    "alert_firing": "alert firing",
+    "alert_resolved": "alert resolved",
 }
 
 #: Instants that belong on the engine track and may carry a request
@@ -372,6 +375,12 @@ def _sample_snapshots() -> list[dict]:
                 [10, base + 1_200_000, "t_route", "sender/data", ctx, 150_000],
                 [11, base + 1_500_000, "t_deliver", "receiver/in", None, 400_000],
                 [12, base + 1_600_000, "drop_oldest", "receiver/in", 3, None],
+                # Alert engine transitions land on the daemon track
+                # (dora_tpu.alerts via Daemon.sample_history).
+                [13, base + 1_700_000, "alert_pending",
+                 "queue-depth:receiver/in", "value=300 threshold=256", None],
+                [14, base + 1_800_000, "alert_firing",
+                 "queue-depth:receiver/in", "value=310 threshold=256", None],
             ],
             "sender": [
                 [20, base + 1_000_000, "t_send", "data", ctx, 90_000],

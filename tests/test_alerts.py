@@ -1,0 +1,767 @@
+"""Alerting plane: rules engine, state machine, sinks, lint, surfaces.
+
+The engine (dora_tpu/alerts.py) evaluates declarative rules over the
+retained metrics rings (metrics_history) and drives a pending → firing
+→ resolved state machine per (rule, instance) with hysteresis and
+edge-triggered dedup. These tests drive real MetricsHistoryRing objects
+tick by tick — no daemon — plus the coordinator-merged twin, the prom
+and CLI render surfaces, the sink chain, and the deploy-time lint
+(analysis.alertcheck).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dora_tpu.alerts import (
+    AlertEngine,
+    AlertRule,
+    AlertsPolicy,
+    JsonlSink,
+    WebhookSink,
+    active_alerts,
+    default_rule_pack,
+    engine_for,
+    match_selector,
+    merge_alert_status,
+    resolved_rules,
+    selector_class,
+    sinks_from_env,
+)
+from dora_tpu.metrics_history import (
+    MetricsHistoryRing,
+    merge_history_snapshots,
+)
+
+G = 1_000_000_000  # ns per second
+
+
+# ---------------------------------------------------------------------------
+# rule + policy parsing
+# ---------------------------------------------------------------------------
+
+
+def _rule(**over) -> AlertRule:
+    base = {"name": "r", "kind": "gauge", "selector": "queue:*",
+            "op": ">", "threshold": 100}
+    base.update(over)
+    return AlertRule.parse(base)
+
+
+def test_rule_parse_fills_defaults():
+    r = _rule()
+    assert r.for_s == 0.0 and r.severity == "warning"
+    assert r.clear_s is None and r.resolve_threshold is None
+
+
+@pytest.mark.parametrize("bad", [
+    {"kind": "nope"},
+    {"op": "=="},
+    {"severity": "page-me"},
+    {"selector": "srv:*:*"},                        # two wildcards
+    {"kind": "ratio"},                              # ratio needs denominator
+    {"denominator": "queue:*"},                     # denominator on gauge
+    {"kind": "ratio", "denominator": "srv:a:requests"},  # wildcard mismatch
+    {"labels": "prod"},                             # labels not a mapping
+    {"bogus_key": 1},
+])
+def test_rule_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        _rule(**bad)
+
+
+def test_rule_parse_requires_core_fields():
+    with pytest.raises(ValueError):
+        AlertRule.parse({"name": "x", "kind": "gauge"})
+    with pytest.raises(ValueError):
+        AlertRule.parse("not-a-mapping")
+
+
+def test_policy_rejects_duplicate_names_and_unknown_keys():
+    with pytest.raises(ValueError):
+        AlertsPolicy.parse({"rules": [
+            {"name": "a", "kind": "gauge", "selector": "queue:*",
+             "op": ">", "threshold": 1},
+            {"name": "a", "kind": "gauge", "selector": "queue:*",
+             "op": ">", "threshold": 2},
+        ]})
+    with pytest.raises(ValueError):
+        AlertsPolicy.parse({"extra": []})
+    assert AlertsPolicy.parse(None) is None
+
+
+def test_resolved_rules_merges_policy_over_pack():
+    pack_names = {r.name for r in default_rule_pack()}
+    assert "queue-depth" in pack_names and "lora-thrash" in pack_names
+    policy = AlertsPolicy.parse({
+        "disable": ["trace-truncated"],
+        "rules": [
+            # same-name override wins...
+            {"name": "queue-depth", "kind": "gauge", "selector": "queue:*",
+             "op": ">", "threshold": 7},
+            # ...new rules append.
+            {"name": "my-rule", "kind": "gauge",
+             "selector": "srv:llm:backlog_depth", "op": ">", "threshold": 1},
+        ],
+    })
+    rules = {r.name: r for r in resolved_rules(policy)}
+    assert "trace-truncated" not in rules
+    assert rules["queue-depth"].threshold == 7
+    assert "my-rule" in rules
+    # No policy = the pack verbatim.
+    assert {r.name for r in resolved_rules(None)} == pack_names
+
+
+def test_default_pack_selectors_name_known_families():
+    """Every non-burn pack rule must survive its own lint: a pack rule
+    naming a renamed series key is a silent never-fires alert."""
+    for rule in default_rule_pack():
+        if rule.kind == "burn":
+            continue
+        assert selector_class(rule.selector) is not None, rule.name
+        if rule.denominator:
+            assert selector_class(rule.denominator) is not None, rule.name
+
+
+def test_match_selector():
+    assert match_selector("queue:*", "queue:recv/in") == "recv/in"
+    assert match_selector("srv:*:shed", "srv:llm:shed") == "llm"
+    assert match_selector("srv:*:shed", "srv:llm:requests") is None
+    assert match_selector("logerr:cam", "logerr:cam") == ""
+    assert match_selector("logerr:cam", "logerr:llm") is None
+
+
+# ---------------------------------------------------------------------------
+# state machine over a real ring
+# ---------------------------------------------------------------------------
+
+
+def _drive(engine, ring, snaps, start_ns=1_000 * G, step_ns=G):
+    """Sample one snapshot per tick and evaluate; returns all events."""
+    events = []
+    t = start_ns
+    for snap in snaps:
+        ring.sample(snap, t, t)
+        events += engine.evaluate_ring(ring, now_ns=t)
+        t += step_ns
+    return events
+
+
+def _qd(depth: float) -> dict:
+    return {"queue_depth": {"recv/in": depth}}
+
+
+def test_gauge_lifecycle_pending_firing_resolved():
+    rule = _rule(threshold=100, for_s=3, resolve_threshold=50, clear_s=2,
+                 severity="critical")
+    ring = MetricsHistoryRing(capacity=32, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [
+        _qd(120), _qd(120), _qd(120), _qd(120),  # t0 pending, t3 firing
+        _qd(80),                                  # above resolve: holds
+        _qd(40), _qd(40), _qd(40),                # t5 clear start, t7 resolved
+    ])
+    phases = [(e["phase"], e["value"]) for e in events]
+    assert phases == [("pending", 120), ("firing", 120), ("resolved", 40)]
+    assert all(e["instance"] == "queue:recv/in" for e in events)
+    assert all(e["severity"] == "critical" for e in events)
+    assert eng.transitions == {"pending": 1, "firing": 1, "resolved": 1}
+    assert eng.firing_total == {"r": 1} and eng.resolved_total == {"r": 1}
+    inst = eng.status()["rules"]["r"]["instances"]["queue:recv/in"]
+    assert inst["state"] == "ok" and inst["incidents"] == 1
+
+
+def test_zero_for_duration_fires_on_the_same_tick():
+    rule = _rule(threshold=100)
+    ring = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [_qd(120)])
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+
+
+def test_pending_cancels_silently():
+    """A condition that clears before for_s elapses never fired, so it
+    must not emit a resolved event either (edge-triggered dedup)."""
+    rule = _rule(threshold=100, for_s=5)
+    ring = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [_qd(120), _qd(120), _qd(10), _qd(10)])
+    assert [e["phase"] for e in events] == ["pending"]
+    assert eng.transitions["firing"] == 0
+    assert eng.transitions["resolved"] == 0
+    inst = eng.status()["rules"]["r"]["instances"]["queue:recv/in"]
+    assert inst["state"] == "ok" and inst["incidents"] == 0
+
+
+def test_flap_between_threshold_and_resolve_stays_firing():
+    """Hysteresis: once firing, only dropping below resolve_threshold
+    (not merely below threshold) starts the clear streak — a value
+    oscillating in the band must not flap resolve/re-fire."""
+    rule = _rule(threshold=100, resolve_threshold=50, for_s=0, clear_s=2)
+    ring = MetricsHistoryRing(capacity=32, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [
+        _qd(120), _qd(60), _qd(120), _qd(60), _qd(120), _qd(60),
+    ])
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+    assert eng.status()["firing"] == 1
+    # An incursion below resolve that is shorter than clear_s also holds.
+    events = _drive(eng, ring, [_qd(40), _qd(120)],
+                    start_ns=1_006 * G)
+    assert events == []
+    # A sustained clear finally resolves.
+    events = _drive(eng, ring, [_qd(40), _qd(40), _qd(40)],
+                    start_ns=1_008 * G)
+    assert [e["phase"] for e in events] == ["resolved"]
+
+
+def test_refire_after_resolve_is_a_new_incident():
+    rule = _rule(threshold=100, for_s=0, clear_s=1)
+    ring = MetricsHistoryRing(capacity=32, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [
+        _qd(120), _qd(10), _qd(10),   # incident 1 fires then resolves
+        _qd(120), _qd(10), _qd(10),   # incident 2
+    ])
+    phases = [e["phase"] for e in events]
+    assert phases == ["pending", "firing", "resolved",
+                      "pending", "firing", "resolved"]
+    assert eng.firing_total == {"r": 2} and eng.resolved_total == {"r": 2}
+    inst = eng.status()["rules"]["r"]["instances"]["queue:recv/in"]
+    assert inst["incidents"] == 2
+
+
+def _srv_shed(cum: float) -> dict:
+    return {"serving": {"llm": {"shed": cum}}}
+
+
+def test_rate_rule_survives_counter_reset_mid_window():
+    """A respawned node re-reports its counters from zero. The ring
+    stores the new cumulative as the delta (never a negative rate), so
+    a firing rate alert resolves cleanly instead of exploding or
+    wedging on garbage."""
+    rule = AlertRule.parse({
+        "name": "shed", "kind": "rate", "selector": "srv:*:shed",
+        "op": ">", "threshold": 50, "for_s": 0, "clear_s": 2,
+        "window_s": 4,
+    })
+    ring = MetricsHistoryRing(capacity=32, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [
+        _srv_shed(0), _srv_shed(100), _srv_shed(200), _srv_shed(300),
+    ])
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+    assert events[-1]["value"] > 50
+    # Node respawns: cumulative drops to 2 then barely moves.
+    events = _drive(eng, ring, [
+        _srv_shed(2), _srv_shed(3), _srv_shed(4), _srv_shed(5),
+        _srv_shed(6), _srv_shed(7),
+    ], start_ns=1_004 * G)
+    assert ring.resets.get("srv:llm:shed") == 1
+    assert [e["phase"] for e in events] == ["resolved"]
+    assert all(e["value"] >= 0 for e in events)
+
+
+def test_ring_wrap_while_pending_still_fires():
+    """The for_s streak lives in the engine, not the ring: a rule whose
+    for-duration outlasts the ring's retention still transitions to
+    firing after the ring wrapped (and counted its drops)."""
+    rule = _rule(threshold=100, for_s=6)
+    ring = MetricsHistoryRing(capacity=4, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = _drive(eng, ring, [_qd(120)] * 10)
+    assert ring.dropped > 0
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+
+
+def test_absent_series_never_fires_then_decays_when_it_vanishes():
+    # window_s=1 so an old gauge falls out of the window once its node
+    # stops reporting (gauges persist across the whole window otherwise).
+    rule = _rule(threshold=100, for_s=0, clear_s=2, window_s=1)
+    ring = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    # No matching series at all: no instances, no events.
+    assert _drive(eng, ring, [{"links": {}}]) == []
+    assert eng.status()["rules"] == {}
+    # Fires, then the gauge disappears from snapshots entirely (node
+    # gone): the instance decays through the clear path.
+    ring2 = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    empty = {"links": {}}
+    events = _drive(eng, ring2, [_qd(120), empty, empty, empty, empty])
+    assert [e["phase"] for e in events] == ["pending", "firing", "resolved"]
+
+
+def test_gauge_ratio_rule_hbm_style():
+    rule = AlertRule.parse({
+        "name": "hbm", "kind": "gauge_ratio",
+        "selector": "srv:*:hbm_used_bytes",
+        "denominator": "srv:*:hbm_limit_bytes",
+        "op": ">", "threshold": 0.9,
+    })
+    ring = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+    snap = {"serving": {"llm": {"hbm_used_bytes": 95, "hbm_limit_bytes": 100}}}
+    events = _drive(eng, ring, [snap])
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+    assert events[-1]["value"] == 0.95
+
+
+def test_ratio_rule_min_rate_guards_idle_denominator():
+    rule = AlertRule.parse({
+        "name": "thrash", "kind": "ratio", "selector": "srv:*:lora_loads",
+        "denominator": "srv:*:requests", "op": ">", "threshold": 0.5,
+        "min_rate": 1.0, "window_s": 4,
+    })
+    ring = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0)
+
+    def snap(loads, reqs):
+        return {"serving": {"llm": {"lora_loads": loads, "requests": reqs}}}
+
+    # Idle engine: 1 load / 1 request over the window is a 1.0 ratio,
+    # but the denominator rate is below min_rate — no instance at all.
+    events = _drive(eng, ring, [snap(0, 0), snap(1, 1)])
+    assert events == []
+    # Busy engine thrashing: every admission swaps an adapter in.
+    events = _drive(eng, ring, [snap(11, 11), snap(21, 21)],
+                    start_ns=1_002 * G)
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+
+
+# ---------------------------------------------------------------------------
+# cluster merge: HLC-skewed daemons, status union
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_merged_over_hlc_skewed_daemons():
+    """Two daemons sample the same cluster instants; machine B's wall
+    clock lags 500 s but its (wall, hlc) export pair carries the
+    offset. The merged evaluation must see B's queue gauge on the
+    aligned timeline and fire exactly once — a mis-alignment would
+    interleave B's samples 500 s in the past and starve the streak."""
+    base = 1_000 * G
+    skew = 500 * G
+    ra = MetricsHistoryRing(capacity=16, interval_s=1.0)
+    rb = MetricsHistoryRing(capacity=16, interval_s=1.0)
+    for i in range(4):
+        t = base + i * G
+        ra.sample({"links": {"a/o": {"msgs": (i + 1) * 10, "bytes": 0}}},
+                  t, t)
+        rb.sample(_qd(300), t - skew, t)
+    sa = ra.snapshot()
+    sa.update(machine_id="A", wall_ns=base + 4 * G, hlc_ns=base + 4 * G)
+    sb = rb.snapshot()
+    sb.update(machine_id="B", wall_ns=base + 4 * G - skew,
+              hlc_ns=base + 4 * G)
+    merged = merge_history_snapshots([sa, sb])
+
+    rule = _rule(threshold=256, for_s=2)
+    eng = AlertEngine([rule], interval_s=1.0)
+    events = []
+    for i in range(4):
+        events += eng.evaluate_merged(merged, now_ns=base + i * G)
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+    assert events[-1]["instance"] == "queue:recv/in"
+
+
+def test_merge_alert_status_unions_machines():
+    def status_of(eng):
+        return eng.status()
+
+    rule = _rule(threshold=100)
+    ra = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    ea = AlertEngine([rule], interval_s=1.0)
+    _drive(ea, ra, [{"queue_depth": {"a/in": 120}}])
+    rb = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eb = AlertEngine([rule], interval_s=1.0)
+    _drive(eb, rb, [{"queue_depth": {"b/in": 130}}])
+    merged = merge_alert_status([status_of(ea), status_of(eb), {}])
+    insts = merged["rules"]["r"]["instances"]
+    assert set(insts) == {"queue:a/in", "queue:b/in"}
+    assert merged["firing"] == 2
+    assert merged["transitions"]["firing"] == 2
+    assert merged["firing_total"] == {"r": 2}
+    rows = active_alerts(merged)
+    assert [r["instance"] for r in rows] == ["queue:a/in", "queue:b/in"]
+    assert all(r["state"] == "firing" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+def _event() -> dict:
+    return {"phase": "firing", "rule": "r", "instance": "queue:recv/in",
+            "severity": "warning", "value": 300, "threshold": 256,
+            "labels": {}, "unix_s": 1000.0}
+
+
+def test_jsonl_sink_appends_one_object_per_event(tmp_path):
+    path = tmp_path / "alerts.jsonl"
+    sink = JsonlSink(str(path))
+    sink.emit(_event())
+    sink.emit(_event())
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[0])["rule"] == "r"
+    assert sink.errors == 0
+
+
+def test_webhook_sink_retry_budget_is_bounded(monkeypatch):
+    """A dead webhook gets exactly 1 + retries attempts per event, the
+    failure is counted, and nothing raises — the sampler must survive
+    its own alerting."""
+    calls = []
+
+    def dead(req, timeout=None):
+        calls.append(req)
+        raise OSError("connection refused")
+
+    monkeypatch.setattr("urllib.request.urlopen", dead)
+    sink = WebhookSink("http://alerts.invalid/hook", retries=3)
+    sink.emit(_event())
+    assert len(calls) == 1 + 3
+    assert sink.failures == 1 and sink.delivered == 0
+
+
+def test_webhook_sink_success_posts_json_once(monkeypatch):
+    seen = []
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def ok(req, timeout=None):
+        seen.append(req)
+        return _Resp()
+
+    monkeypatch.setattr("urllib.request.urlopen", ok)
+    sink = WebhookSink("http://alerts.invalid/hook", retries=3)
+    sink.emit(_event())
+    assert len(seen) == 1
+    assert sink.delivered == 1 and sink.failures == 0
+    body = json.loads(seen[0].data.decode())
+    assert body["rule"] == "r" and body["phase"] == "firing"
+    assert seen[0].get_header("Content-type") == "application/json"
+
+
+def test_failing_sink_never_breaks_evaluation():
+    class Boom:
+        def emit(self, event):
+            raise RuntimeError("sink down")
+
+    rule = _rule(threshold=100)
+    ring = MetricsHistoryRing(capacity=8, interval_s=1.0)
+    eng = AlertEngine([rule], interval_s=1.0, sinks=[Boom()])
+    events = _drive(eng, ring, [_qd(120)])
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+
+
+def test_sinks_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("DORA_ALERT_SINK", "log,jsonl,webhook,bogus")
+    monkeypatch.setenv("DORA_ALERT_SINK_FILE", str(tmp_path / "a.jsonl"))
+    monkeypatch.setenv("DORA_ALERT_SINK_WEBHOOK", "http://alerts.invalid/h")
+    monkeypatch.setenv("DORA_ALERT_WEBHOOK_RETRIES", "5")
+    sinks = sinks_from_env()
+    kinds = [type(s).__name__ for s in sinks]
+    assert kinds == ["LogSink", "JsonlSink", "WebhookSink"]
+    assert sinks[2].retries == 5
+    # Misconfigured entries are skipped, not fatal.
+    monkeypatch.delenv("DORA_ALERT_SINK_WEBHOOK")
+    monkeypatch.setenv("DORA_ALERT_SINK", "webhook")
+    assert sinks_from_env() == []
+
+
+def test_engine_for_honors_disable_env(monkeypatch):
+    monkeypatch.setenv("DORA_ALERTS", "0")
+    assert engine_for(None, interval_s=1.0) is None
+    monkeypatch.setenv("DORA_ALERTS", "1")
+    eng = engine_for(None, interval_s=1.0, sinks=[])
+    assert eng is not None
+    assert {r.name for r in eng.rules} == {
+        r.name for r in default_rule_pack()
+    }
+
+
+# ---------------------------------------------------------------------------
+# deterministic firing end-to-end: ring -> engine -> prom -> CLI
+# ---------------------------------------------------------------------------
+
+
+def test_default_pack_firing_end_to_end():
+    """Seeded queue-depth violation through the real default pack at the
+    default 5 s cadence: pending -> firing -> a dora_alerts prom sample
+    in a valid exposition -> the CLI render -> resolved and gone from
+    prom (with the resolved counter left behind)."""
+    from dora_tpu.cli.alerts_view import render_alerts, render_alerts_panel
+    from dora_tpu.prom import render_exposition, validate_exposition
+
+    ring = MetricsHistoryRing(capacity=64, interval_s=5.0)
+    eng = engine_for(None, interval_s=5.0, sinks=[])
+    events = _drive(eng, ring, [_qd(300)] * 3, step_ns=5 * G)
+    # Pack rule: queue-depth > 256 for 10 s (tick 0 pending, tick 2 fires).
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+    assert events[-1]["rule"] == "queue-depth"
+
+    status = eng.status()
+    assert status["firing"] == 1
+    snap = {"queue_depth": {"recv/in": 300}, "alerts": status}
+    text = render_exposition({"demo": snap})
+    assert validate_exposition(text) == []
+    assert ('dora_alerts{alertname="queue-depth",alertstate="firing",'
+            'dataflow="demo",instance="queue:recv/in",severity="warning"} 1'
+            ) in text
+    assert 'dora_alert_firing_total{alertname="queue-depth",' in text
+
+    rendered = render_alerts("demo-uuid", status, now=1_015.0)
+    assert "1 firing / 0 pending" in rendered
+    assert "!! queue-depth" in rendered and "queue:recv/in" in rendered
+    panel = render_alerts_panel(status, now=1_015.0)
+    assert any("queue-depth" in line for line in panel)
+
+    # Drain the queue below the resolve threshold (128) for clear_s
+    # (defaults to for_s = 10 s): resolved, active series gone from
+    # prom, lifetime counter stays.
+    events = _drive(eng, ring, [_qd(10)] * 3, start_ns=1_015 * G,
+                    step_ns=5 * G)
+    assert [e["phase"] for e in events] == ["resolved"]
+    status = eng.status()
+    assert status["firing"] == 0
+    text = render_exposition({"demo": {"alerts": status}})
+    assert "dora_alerts{" not in text
+    assert 'dora_alert_resolved_total{alertname="queue-depth",' in text
+    assert validate_exposition(text) == []
+    # The panel goes quiet; the full CLI table still shows the ok row.
+    assert render_alerts_panel(status, now=1_030.0) == []
+    assert "ok" in render_alerts("demo-uuid", status, now=1_030.0)
+
+
+def test_alert_instants_are_registered_trace_names():
+    from dora_tpu.tracing import INSTANT_NAMES
+
+    for name in ("alert_pending", "alert_firing", "alert_resolved"):
+        assert name in INSTANT_NAMES
+
+
+def test_slo_burn_rule_gates_on_window_complete():
+    """The slo-burn-fast pack rule reads burn_1m only when the ring
+    retains a full window — partial-window burn is noisy (round 9)."""
+    targets = {"llm": {"queue_depth_max": 10}}
+    rule = AlertRule.parse({
+        "name": "burn", "kind": "burn", "selector": "*", "op": ">",
+        "threshold": 0.5, "window_s": 60, "for_s": 0,
+    })
+    ring = MetricsHistoryRing(capacity=128, interval_s=1.0,
+                              slo_targets=targets)
+    eng = AlertEngine([rule], interval_s=1.0)
+    # 30 violating samples: burn over the prefix is 1.0 but the 60 s
+    # window is incomplete — the rule must not fire early.
+    events = _drive(eng, ring, [{"queue_depth": {"llm/in": 50}}] * 30)
+    assert events == []
+    # 30 more complete the window; every sample violates -> burn 1.0.
+    events = _drive(eng, ring, [{"queue_depth": {"llm/in": 50}}] * 30,
+                    start_ns=1_030 * G)
+    assert [e["phase"] for e in events] == ["pending", "firing"]
+    assert events[-1]["instance"] == "llm"
+
+
+# ---------------------------------------------------------------------------
+# lint (analysis.alertcheck)
+# ---------------------------------------------------------------------------
+
+
+def _descriptor_with(rules):
+    from dora_tpu.core.descriptor import Descriptor
+
+    return Descriptor.parse({
+        "nodes": [{"id": "n", "path": "noop.py"}],
+        "alerts": {"rules": rules},
+    })
+
+
+def test_alertcheck_default_pack_is_clean():
+    from dora_tpu.analysis.alertcheck import check_alerts
+    from dora_tpu.core.descriptor import Descriptor
+
+    d = Descriptor.parse({"nodes": [{"id": "n", "path": "noop.py"}]})
+    assert check_alerts(d, interval_s=5.0) == []
+
+
+def test_alertcheck_flags_bad_rules():
+    from dora_tpu.analysis.alertcheck import check_alerts
+
+    d = _descriptor_with([
+        {"name": "typo", "kind": "gauge", "selector": "srv:llm:sheds",
+         "op": ">", "threshold": 1},
+        {"name": "p99-on-counter", "kind": "percentile",
+         "selector": "srv:llm:shed", "op": ">", "threshold": 1},
+        {"name": "rate-on-gauge", "kind": "rate", "selector": "queue:*",
+         "op": ">", "threshold": 1},
+        {"name": "hair-trigger", "kind": "gauge", "selector": "queue:*",
+         "op": ">", "threshold": 1, "for_s": 2},
+    ])
+    codes = {f.where: f.code for f in check_alerts(d, interval_s=5.0)}
+    assert codes["alerts/typo"] == "alert-unknown-metric"
+    assert codes["alerts/p99-on-counter"] == "alert-percentile-non-histogram"
+    assert codes["alerts/rate-on-gauge"] == "alert-kind-mismatch"
+    assert codes["alerts/hair-trigger"] == "alert-for-below-cadence"
+    assert all(f.level == "error" for f in check_alerts(d, interval_s=5.0))
+
+
+def test_alertcheck_webhook_without_endpoint(monkeypatch):
+    from dora_tpu.analysis.alertcheck import check_alert_env
+
+    assert check_alert_env({"DORA_ALERT_SINK": "log"}) == []
+    findings = check_alert_env({"DORA_ALERT_SINK": "log,webhook"})
+    assert [f.code for f in findings] == ["alert-webhook-no-endpoint"]
+    assert check_alert_env({
+        "DORA_ALERT_SINK": "webhook",
+        "DORA_ALERT_SINK_WEBHOOK": "http://alerts.invalid/h",
+    }) == []
+
+
+def test_descriptor_alerts_block_parses_and_schema_accepts():
+    jsonschema = pytest.importorskip("jsonschema")
+    from dora_tpu.core.descriptor import Descriptor
+    from dora_tpu.core.schema import descriptor_schema
+
+    raw = {
+        "nodes": [{"id": "n", "path": "noop.py"}],
+        "alerts": {
+            "disable": ["trace-truncated"],
+            "rules": [{"name": "deep", "kind": "gauge",
+                       "selector": "queue:n/in", "op": ">",
+                       "threshold": 10, "for_s": 30,
+                       "severity": "critical"}],
+        },
+    }
+    d = Descriptor.parse(raw)
+    assert d.alerts is not None
+    assert d.alerts.disable == ("trace-truncated",)
+    assert d.alerts.rules[0].name == "deep"
+    validator = jsonschema.Draft7Validator(descriptor_schema())
+    assert list(validator.iter_errors(raw)) == []
+    # Schema catches a bad kind before the engine ever sees it.
+    bad = dict(raw, alerts={"rules": [{"name": "x", "kind": "nope",
+                                      "selector": "queue:*", "op": ">",
+                                      "threshold": 1}]})
+    assert list(validator.iter_errors(bad)) != []
+
+
+# ---------------------------------------------------------------------------
+# structured log severity (satellite: message.common.parse_level_prefix)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("line,expected", [
+    ("[ERROR] device lost", "error"),
+    ("ERROR: device lost", "error"),
+    ("2026-08-07 12:00:01 WARN queue backing up", "warn"),
+    ("warning: deprecated flag", "warn"),
+    ("INFO starting up", "info"),
+    ("<debug> verbose detail", "debug"),
+    ("TRACE enter loop", "trace"),
+    ("err: short form", "error"),
+    ("FATAL exception in thread", "error"),
+    ("CRITICAL disk full", "error"),
+    ("plain progress output", None),
+    ("E 1234 too-short token", None),
+    ("", None),
+])
+def test_parse_level_prefix(line, expected):
+    from dora_tpu.message.common import parse_level_prefix
+
+    assert parse_level_prefix(line) == expected
+
+
+# ---------------------------------------------------------------------------
+# adapter-residency stall attribution (satellite: AdmissionQueue)
+# ---------------------------------------------------------------------------
+
+
+class _ResidencyEngine:
+    """Engine whose admit_blocker distinguishes a pinned-adapter stall
+    from plain capacity, like PagedBatchEngine.admit_blocker: the pool
+    has room but the tenant's adapter cannot evict a pinned resident."""
+
+    def __init__(self):
+        self.blocked = "capacity"
+        self.admits = 0
+
+    def can_admit(self, plen, max_new, adapter=None):
+        if self.blocked:
+            return False
+        self.admits += 1
+        return True
+
+    def admit_blocker(self, plen, max_new, adapter=None):
+        return self.blocked
+
+
+def test_stall_attribution_transitions_and_clears():
+    from dora_tpu.nodehub.llm_server import AdmissionQueue
+
+    eng = _ResidencyEngine()
+    stalls: list[tuple[str, str]] = []
+    admitted: list[tuple[str, str | None]] = []
+    q = AdmissionQueue(
+        eng, lambda k, ids, mn, ad=None: None,
+        on_admit=lambda k, waited: admitted.append((k, q.stall_reason(k))),
+        on_stall=lambda k, reason: stalls.append((k, reason)),
+    )
+    q.push("r1", [1, 2, 3], 4, adapter="tenant-b")
+    # Parked on plain capacity: attributed once, not per drain.
+    assert stalls == [("r1", "capacity")]
+    q.drain()
+    assert stalls == [("r1", "capacity")]
+    # Pages freed but the adapter still can't evict: the stall is
+    # re-attributed — without the transition it reads as overload.
+    eng.blocked = "adapter_residency"
+    q.drain()
+    assert stalls == [("r1", "capacity"), ("r1", "adapter_residency")]
+    # The blocker clears: on_admit still sees the last reason, then the
+    # episode's tag is dropped.
+    eng.blocked = None
+    q.drain()
+    assert admitted == [("r1", "adapter_residency")]
+    assert q.stall_reason("r1") is None
+
+
+def test_paged_admit_blocker_names_adapter_residency():
+    """PagedBatchEngine.admit_blocker: 'adapter_residency' only when the
+    request would otherwise admit and the known adapter can't fit."""
+    from dora_tpu.models.batch_engine import PagedBatchEngine
+
+    class _Lora:
+        def __init__(self, has, fits):
+            self._has, self._fits = has, fits
+
+        def has(self, name):
+            return self._has
+
+        def fits(self, name):
+            return self._fits
+
+    eng = PagedBatchEngine.__new__(PagedBatchEngine)
+    eng.lora = _Lora(has=True, fits=False)
+    admit = {"with": False, "without": True}
+    eng.can_admit = lambda p, m, a=None: (
+        admit["with"] if a else admit["without"]
+    )
+    assert eng.admit_blocker(4, 4, "b") == "adapter_residency"
+    # Not admissible even without the adapter: plain capacity.
+    admit["without"] = False
+    assert eng.admit_blocker(4, 4, "b") == "capacity"
+    # Admissible outright: no blocker.
+    admit.update({"with": True, "without": True})
+    assert eng.admit_blocker(4, 4, "b") is None
+    # Unknown adapter (a load, not an eviction stall): capacity.
+    admit["with"] = False
+    eng.lora = _Lora(has=False, fits=False)
+    assert eng.admit_blocker(4, 4, "b") == "capacity"

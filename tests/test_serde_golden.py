@@ -144,6 +144,18 @@ def test_metrics_history_messages_are_registered():
         assert name in _REGISTRY, name
 
 
+def test_alerts_messages_are_registered():
+    """The alerting quartet must be wire types too — same rationale as
+    the metrics-history quartet above."""
+    for name in (
+        "QueryAlerts",
+        "AlertsReply",
+        "AlertsRequest",
+        "AlertsReplyFromDaemon",
+    ):
+        assert name in _REGISTRY, name
+
+
 def test_unknown_tag_decodes_as_plain_dict_in_both_paths():
     wire = {"t": "NotARegisteredMessage", "f": {"x": 1}}
     raw = msgpack.packb(wire, use_bin_type=True)
