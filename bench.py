@@ -708,6 +708,42 @@ def serving_profiling_ab() -> dict:
     return data
 
 
+def serving_fleet_digest_ab() -> dict:
+    """Fleet-digest A/B (tools/bench_serving --fleet-digest-ab): the
+    engine-state exporter publishing at a 0.5 s cadence (4x the shipped
+    default) vs off on the 16-stream stub serving leg, interleaved
+    paired trials. Gate: <= 3% wall-clock overhead so the fleet plane
+    can stay default-on. Fresh subprocess for the same
+    accelerator-claim reason as serving_engine_ab."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "dora_tpu.tools.bench_serving",
+            "--fleet-digest-ab",
+        ],
+        capture_output=True, text=True, timeout=1800,
+        cwd=str(Path(__file__).resolve().parent),
+    )
+    data = None
+    for line in (proc.stdout or "").splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "fleet_digest_ab" in row:
+            data = row["fleet_digest_ab"]
+    if proc.returncode != 0 or data is None:
+        return {
+            "digest_off_wall_s": None,
+            "digest_on_wall_s": None,
+            "overhead_pct": None,
+            "note": f"subprocess failed: {(proc.stderr or '')[-200:]!r}",
+        }
+    return data
+
+
 def serving_spec_ab() -> dict:
     """Speculative-decoding sweep (tools/bench_serving --spec-ab):
     tokens per dispatch and draft acceptance at spec_k in {0, 2, 4} x
@@ -1116,6 +1152,16 @@ def main() -> int:
         }
 
     try:
+        fleet_digest_ab = serving_fleet_digest_ab()
+    except Exception as exc:
+        fleet_digest_ab = {
+            "digest_off_wall_s": None,
+            "digest_on_wall_s": None,
+            "overhead_pct": None,
+            "note": f"failed: {exc!r}"[:200],
+        }
+
+    try:
         qos_soak = serving_qos_soak()
     except Exception as exc:
         qos_soak = {
@@ -1194,6 +1240,7 @@ def main() -> int:
         "serving_trace_ab": trace_ab,
         "serving_spec_ab": spec_ab,
         "serving_profiling_ab": profiling_ab,
+        "fleet_digest_ab": fleet_digest_ab,
         "serving_qos_soak": qos_soak,
         "serving_prefix_ab": prefix_ab,
         "serving_quant_ab": quant_ab,

@@ -292,7 +292,24 @@ def default_rule_pack() -> list[AlertRule]:
         r({"name": "log-errors", "kind": "rate", "selector": "logerr:*",
            "op": ">", "threshold": 1.0, "for_s": 10,
            "resolve_threshold": 0.2, "severity": "warning"}),
+        # Fleet plane (round 21): a serving replica whose digest age
+        # exceeds 3x the publish cadence is alive (metrics still flow)
+        # but its state export is wedged — routers are placing against
+        # stale prefix claims. Threshold follows DORA_FLEET_DIGEST_S.
+        r({"name": "fleet-digest-stale", "kind": "gauge",
+           "selector": "fleet:*:digest_age_s", "op": ">",
+           "threshold": _fleet_stale_threshold_s(),
+           "for_s": 5, "severity": "warning"}),
     ]
+
+
+def _fleet_stale_threshold_s() -> float:
+    """3x the fleet publish cadence (dora_tpu.fleet.stale_after_s),
+    read lazily so the pack follows the env without an import cycle
+    (fleet imports nothing from alerts, but keep the seam thin)."""
+    from dora_tpu.fleet import stale_after_s
+
+    return stale_after_s()
 
 
 def resolved_rules(policy: "AlertsPolicy | None") -> list[AlertRule]:
@@ -348,6 +365,13 @@ SERVING_GAUGE_NAMES = frozenset((
     "kv_pool_bytes", "kv_quant_err", "kv_int8", "checkpoint_age_s",
 ))
 
+#: fleet:<node>:<name> series (dora_tpu.fleet.fleet_gauges) — all
+#: gauges: digest-derived instantaneous state, never cumulative.
+FLEET_GAUGE_NAMES = frozenset((
+    "digest_age_s", "free_streams", "used_pages", "total_pages",
+    "occupancy", "prefix_pages",
+))
+
 #: non-serving series prefixes by class.
 _COUNTER_PREFIXES = ("drop:", "respawn:", "replay:", "logerr:",
                      "logwarn:", "tracedrop:")
@@ -383,6 +407,13 @@ def selector_class(selector: str) -> str | None:
         if name in SERVING_GAUGE_NAMES:
             return "gauge"
         if name.startswith(("qos_depth:", "adapter_streams:")):
+            return "gauge"
+    if selector.startswith("fleet:"):
+        rest = selector[len("fleet:"):]
+        if ":" not in rest:
+            return None
+        name = rest.split(":", 1)[1]
+        if name in FLEET_GAUGE_NAMES:
             return "gauge"
     return None
 

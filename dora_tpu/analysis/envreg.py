@@ -60,6 +60,8 @@ REGISTRY: dict[str, EnvVar] = dict((
     _e("DORA_ALERT_SINK_FILE", "path", "", "JSONL alert sink output file", True),
     _e("DORA_ALERT_SINK_WEBHOOK", "str", "", "webhook alert sink POST URL", True),
     _e("DORA_ALERT_WEBHOOK_RETRIES", "int", "2", "extra webhook delivery attempts per alert", True),
+    _e("DORA_FLEET_DIGEST_S", "float", "2.0", "engine-state digest publish cadence (0 disables)", True),
+    _e("DORA_FLEET_TOP_PREFIXES", "int", "32", "cached prefixes per engine digest", True),
     _e("DORA_PROM_PORT", "int", "", "coordinator Prometheus exporter port", True),
     _e("DORA_DEVICE_MONITOR", "bool", "1", "sample HBM/MFU device gauges", True),
     _e("DORA_DEVICE_PEAK_FLOPS", "float", "", "override device peak FLOP/s for MFU", True),

@@ -32,6 +32,16 @@ deploy-time diagnostics with machine-readable codes:
 * ``graph-qos-deadline-quantum`` — ``shed_wait_ms`` below the fused
   decode window quantum (``DORA_MULTISTEP_K`` steps): every queued
   request sheds before one window can complete.
+* ``graph-fleet-duplicate-replica`` — two serving nodes with the same
+  id: the merged fleet view (``dora-tpu fleet``) keys replicas by node
+  id, so their engine digests would silently overwrite each other.
+* ``graph-fleet-unrouted`` — several serving replicas share a
+  model/config fingerprint (same model id, K, spec_k, kv dtype, weight
+  bits — interchangeable placement targets) but no upstream node fans
+  out to more than one of them, so nothing is positioned to consume
+  the fleet state and steer requests by prefix affinity/occupancy
+  (``dora_tpu.fleet.score_placement``). Each replica serves a private
+  pipeline and the fleet plane is decorative.
 """
 
 from __future__ import annotations
@@ -99,6 +109,7 @@ def check_descriptor(
     out += _cycle_deadlocks(descriptor)
     out += _restart_p2p(descriptor)
     out += _qos_slo(descriptor)
+    out += _fleet(descriptor)
     return out
 
 
@@ -354,4 +365,101 @@ def _qos_slo(descriptor) -> list[Finding]:
                     {"shed_wait_ms": qos.shed_wait_ms,
                      "quantum_ms": quantum_ms, "k": k},
                 ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fleet: replica identity and routability
+# ---------------------------------------------------------------------------
+
+
+def _node_fingerprint(node, global_env: dict) -> str:
+    """Deploy-time prediction of the config fingerprint this node's
+    engine will publish in its fleet digest — same fields as
+    :func:`dora_tpu.fleet.config_fingerprint`, derived from descriptor
+    env (node env over dataflow env over registry defaults)."""
+    import os
+
+    from dora_tpu import fleet
+
+    def env(name, default=""):
+        v = node.env.get(name, global_env.get(name, default))
+        return str(v) if v is not None else default
+
+    def env_int(name, default):
+        try:
+            return int(env(name, str(default)) or default)
+        except ValueError:
+            return default
+
+    ckpt = env("DORA_HF_CHECKPOINT")
+    model_id = os.path.basename(str(ckpt).rstrip("/")) if ckpt else "stub"
+    if _env_truthy(env("DORA_INT4_DECODE", "0")):
+        weight_bits = 4
+    elif _env_truthy(env("DORA_INT8_DECODE", "0")):
+        weight_bits = 8
+    else:
+        weight_bits = 16
+    return fleet.config_fingerprint(
+        model_id=model_id,
+        window=env_int("DORA_MULTISTEP_K", 8),
+        spec_k=env_int("DORA_SPEC_K", 0),
+        kv_dtype="int8" if _env_truthy(env("DORA_KV_INT8", "0")) else "fp",
+        weight_bits=weight_bits,
+        page_size=env_int("DORA_PAGE_SIZE", 64),
+    )
+
+
+def _fleet(descriptor) -> list[Finding]:
+    global_env = (descriptor.raw or {}).get("env") or {}
+    serving = [n for n in descriptor.nodes if _is_serving(n)]
+    out: list[Finding] = []
+
+    seen: set[str] = set()
+    for node in serving:
+        nid = str(node.id)
+        if nid in seen:
+            out.append(Finding(
+                "graphcheck", "graph-fleet-duplicate-replica", "error", nid,
+                f"serving replica id {nid!r} declared more than once — the "
+                "fleet view keys replicas by node id, so their engine "
+                "digests would overwrite each other",
+            ))
+        seen.add(nid)
+
+    if len(serving) < 2:
+        return out
+
+    # An upstream node that fans out to >=2 replicas of a fingerprint
+    # group is positioned to route by fleet state; without one, the
+    # "interchangeable" replicas can never actually trade traffic.
+    by_fp: dict[str, list[str]] = {}
+    for node in serving:
+        by_fp.setdefault(_node_fingerprint(node, global_env), []).append(
+            str(node.id)
+        )
+    upstreams: dict[str, set[str]] = {}  # source node -> replica ids fed
+    for node in serving:
+        for inp in node.inputs.values():
+            m = inp.mapping
+            if isinstance(m, UserMapping):
+                upstreams.setdefault(str(m.source), set()).add(str(node.id))
+
+    for fp, ids in sorted(by_fp.items()):
+        if len(ids) < 2:
+            continue
+        group = set(ids)
+        routed = any(len(fed & group) > 1 for fed in upstreams.values())
+        if not routed:
+            members = sorted(group)
+            out.append(Finding(
+                "graphcheck", "graph-fleet-unrouted", "warning",
+                ", ".join(members),
+                f"{len(members)} serving replicas share config fingerprint "
+                f"{fp} (interchangeable placement targets) but no upstream "
+                "node feeds more than one of them — nothing consumes the "
+                "fleet state to steer requests (see `dora-tpu fleet` and "
+                "fleet.score_placement)",
+                {"fingerprint": fp, "replicas": members},
+            ))
     return out

@@ -505,7 +505,9 @@ def cmd_top(args) -> int:
                 print(hist_reply.message, file=sys.stderr)
                 return 1
             if args.json:
-                print(json.dumps(hist_reply.history, indent=2, sort_keys=True))
+                payload = dict(hist_reply.history)
+                payload["fleet"] = reply.metrics.get("fleet", {})
+                print(json.dumps(payload, indent=2, sort_keys=True))
                 return 0
             text = render_top(
                 reply.dataflow_uuid, reply.metrics, hist_reply.history
@@ -537,6 +539,34 @@ def cmd_alerts(args) -> int:
                 print(json.dumps(reply.alerts, indent=2, sort_keys=True))
                 return 0
             text = render_alerts(reply.dataflow_uuid, reply.alerts)
+            if not args.watch:
+                print(text, end="")
+                return 0
+            print("\x1b[2J\x1b[H" + text, end="", flush=True)
+            time.sleep(args.interval)
+
+
+def cmd_fleet(args) -> int:
+    """Cluster fleet view: every serving replica's latest engine-state
+    digest (prefix-cache summary, free-stream capacity, occupancy,
+    config fingerprint) merged across machines by the coordinator —
+    the observability surface the placement router consumes."""
+    import json
+
+    from dora_tpu.cli.fleet_view import render_fleet
+
+    with _control(args) as c:
+        while True:
+            reply = c.request(
+                cm.QueryFleet(dataflow_uuid=args.uuid, name=args.name)
+            )
+            if isinstance(reply, cm.Error):
+                print(reply.message, file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(reply.fleet, indent=2, sort_keys=True))
+                return 0
+            text = render_fleet(reply.dataflow_uuid, reply.fleet)
             if not args.watch:
                 print(text, end="")
                 return 0
@@ -850,6 +880,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     coordinator_addr(p)
     p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
+        "fleet",
+        help="show every serving replica's engine-state digest (fleet view)",
+    )
+    p.add_argument("--uuid", default=None)
+    p.add_argument("--name", default=None)
+    p.add_argument(
+        "--watch", action="store_true", help="refresh top-style"
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, help="--watch refresh seconds"
+    )
+    p.add_argument(
+        "--json", action="store_true", help="print the raw merged view"
+    )
+    coordinator_addr(p)
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "trace",

@@ -246,6 +246,16 @@ def render_top(uuid: str, snap: dict, history: dict) -> str:
             slo_rows,
         )
 
+    # FLEET: per-replica engine digests (free-stream capacity, page
+    # occupancy, prefix-cache footprint, digest age) — the same gauge
+    # block `dora-tpu fleet` and prom export. Absent on pre-fleet
+    # snapshots, so the panel simply doesn't render there.
+    fleet = snap.get("fleet") or {}
+    if fleet:
+        from dora_tpu.cli.fleet_view import render_fleet_panel
+
+        lines += render_fleet_panel(fleet)
+
     # ALERTS: active (pending/firing) instances from the merged
     # snapshot's alerts block — evaluated daemon-side by the alert
     # engine, so this panel agrees with `dora-tpu alerts` and prom.

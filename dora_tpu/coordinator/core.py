@@ -94,6 +94,7 @@ class Coordinator:
         self._trace_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._history_waiters: dict[tuple[str, str], asyncio.Future] = {}
         self._alerts_waiters: dict[tuple[str, str], asyncio.Future] = {}
+        self._fleet_waiters: dict[tuple[str, str], asyncio.Future] = {}
         #: correlation for deep-capture requests: (dataflow_id, node_id)
         #: -> future resolved by ProfileReplyFromDaemon
         self._profile_waiters: dict[tuple[str, str], asyncio.Future] = {}
@@ -273,6 +274,12 @@ class Coordinator:
             )
             if fut is not None and not fut.done():
                 fut.set_result(event.alerts)
+        elif isinstance(event, cm.FleetReplyFromDaemon):
+            fut = self._fleet_waiters.get(
+                (event.dataflow_id, event.machine_id)
+            )
+            if fut is not None and not fut.done():
+                fut.set_result(event.fleet)
         elif isinstance(event, cm.ProfileReplyFromDaemon):
             fut = self._profile_waiters.get(
                 (event.dataflow_id, event.node_id)
@@ -551,6 +558,38 @@ class Coordinator:
                 self._alerts_waiters.pop((uuid, machine), None)
         return merge_alert_status(
             [s for s in statuses if isinstance(s, dict) and s]
+        )
+
+    async def request_fleet(self, uuid: str) -> dict:
+        """Fan a FleetRequest out to every involved daemon and merge
+        the per-machine digest snapshots into one clock-aligned fleet
+        view (dora_tpu.fleet.merge_fleet_snapshots). Works for archived
+        dataflows too — daemons keep finished dataflow state, last
+        digests included, so a post-mortem `dora-tpu fleet` still shows
+        the final replica states."""
+        from dora_tpu.fleet import merge_fleet_snapshots
+
+        df = self.running.get(uuid)
+        if df is None and uuid in self.archived:
+            df = self.archived[uuid][0]
+        if df is None:
+            raise KeyError(f"unknown dataflow {uuid!r}")
+        loop = asyncio.get_running_loop()
+        futs = []
+        for machine in sorted(df.machines):
+            fut = loop.create_future()
+            self._fleet_waiters[(uuid, machine)] = fut
+            self._daemon_send(machine, cm.FleetRequest(dataflow_id=uuid))
+            futs.append(fut)
+        try:
+            snapshots = await asyncio.wait_for(
+                asyncio.gather(*futs, return_exceptions=True), timeout=10
+            )
+        finally:
+            for machine in df.machines:
+                self._fleet_waiters.pop((uuid, machine), None)
+        return merge_fleet_snapshots(
+            [s for s in snapshots if isinstance(s, dict)]
         )
 
     async def request_trace(self, uuid: str) -> dict:
@@ -862,6 +901,12 @@ class Coordinator:
                 return uuid
             alerts = await self.request_alerts(uuid)
             return cm.AlertsReply(dataflow_uuid=uuid, alerts=alerts)
+        if isinstance(request, cm.QueryFleet):
+            uuid = self._query_target(request.dataflow_uuid, request.name)
+            if isinstance(uuid, cm.Error):
+                return uuid
+            fleet = await self.request_fleet(uuid)
+            return cm.FleetReply(dataflow_uuid=uuid, fleet=fleet)
         if isinstance(request, cm.QueryTrace):
             uuid = self._query_target(request.dataflow_uuid, request.name)
             if isinstance(uuid, cm.Error):

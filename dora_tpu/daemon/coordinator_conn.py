@@ -334,6 +334,17 @@ async def _serve_connection(
                         ),
                     )
                 )
+            elif isinstance(event, cm.FleetRequest):
+                df = daemon.dataflows.get(event.dataflow_id)
+                outbox.put_nowait(
+                    cm.FleetReplyFromDaemon(
+                        dataflow_id=event.dataflow_id,
+                        machine_id=machine_id,
+                        fleet=(
+                            daemon.fleet_snapshot(df) if df is not None else {}
+                        ),
+                    )
+                )
             elif isinstance(event, cm.DestroyDaemon):
                 return True
             else:

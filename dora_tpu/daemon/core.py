@@ -51,6 +51,7 @@ from dora_tpu.message.common import (
     ENCODING_RAW,
 )
 from dora_tpu.message import fastroute
+from dora_tpu import fleet
 from dora_tpu.alerts import AlertEngine, engine_for
 from dora_tpu.metrics import DataflowMetrics
 from dora_tpu.metrics_history import MetricsHistoryRing, history_interval_s
@@ -180,6 +181,11 @@ class DataflowState:
     #: serving plane: node id -> latest ServingMetrics snapshot the node
     #: shipped via ReportServing (latest-wins; snapshots are cumulative)
     node_serving: dict[str, dict] = field(default_factory=dict)
+    #: fleet plane: node id -> {"digest": dict, "recv_wall_ns": int} —
+    #: the latest EngineStateDigest shipped via ReportEngineState with
+    #: its receive stamp (digest age is measured from the stamp, so a
+    #: wedged exporter shows as a growing age, not silence)
+    node_fleet: dict[str, dict] = field(default_factory=dict)
     #: elastic recovery: node id -> respawn attempts consumed so far
     respawn_attempts: dict[str, int] = field(default_factory=dict)
     #: nodes between death and respawn — the finish check treats them
@@ -776,6 +782,14 @@ class Daemon:
             snap["serving"] = {
                 nid: dict(s) for nid, s in df.node_serving.items()
             }
+        if df.node_fleet:
+            now_ns = time.time_ns()
+            snap["fleet"] = {
+                nid: fleet.fleet_gauges(
+                    e["digest"], (now_ns - e["recv_wall_ns"]) / 1e9
+                )
+                for nid, e in df.node_fleet.items()
+            }
         if df.history is not None and df.history.slo_targets:
             snap["slo"] = df.history.slo_status()
         if df.log_counts:
@@ -840,6 +854,24 @@ class Daemon:
         out["hlc_ns"] = self.clock.new_timestamp().physical_ns
         out["wall_ns"] = time.time_ns()
         return out
+
+    def fleet_snapshot(self, df: DataflowState) -> dict:
+        """Per-machine fleet snapshot — the payload of a FleetRequest
+        reply. Latest digest per replica with its receive stamp, plus
+        the back-to-back ``(wall_ns, hlc_ns)`` pair so the merge
+        (dora_tpu.fleet.merge_fleet_snapshots) can align receive stamps
+        onto the cluster HLC timeline, exactly like metrics history."""
+        if not df.node_fleet:
+            return {}
+        return {
+            "machine_id": self.machine_id,
+            "hlc_ns": self.clock.new_timestamp().physical_ns,
+            "wall_ns": time.time_ns(),
+            "replicas": {
+                nid: {**e["digest"], "recv_wall_ns": e["recv_wall_ns"]}
+                for nid, e in df.node_fleet.items()
+            },
+        }
 
     def alerts_snapshot(self, df: DataflowState) -> dict:
         """Per-machine alert-engine status — the payload of an
@@ -1462,6 +1494,11 @@ class Daemon:
                 _extend_trace_buffer(df, node_id, msg.events)
             elif isinstance(msg, n2d.ReportServing):
                 df.node_serving[node_id] = msg.snapshot
+            elif isinstance(msg, n2d.ReportEngineState):
+                df.node_fleet[node_id] = {
+                    "digest": fleet.digest_as_dict(msg.digest),
+                    "recv_wall_ns": time.time_ns(),
+                }
             elif isinstance(msg, n2d.ReportProfile):
                 if self.profile_sink is not None:
                     self.profile_sink(df.id, node_id, msg.artifact, msg.error)

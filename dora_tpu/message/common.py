@@ -169,6 +169,47 @@ class DataflowResult:
 
 
 # ---------------------------------------------------------------------------
+# Fleet state
+# ---------------------------------------------------------------------------
+
+
+@message
+class EngineStateDigest:
+    """A serving replica's shippable state summary (fleet plane).
+
+    Published by every serving engine on the ``DORA_FLEET_DIGEST_S``
+    cadence (node -> daemon -> coordinator, mirroring the metrics
+    plane) so a router can place a request without inspecting any
+    data-plane internals: ``prefixes`` holds the top-N cached radix
+    prefixes as ``[chain, token_len, pages]`` triples (see
+    models/prefix_cache.prompt_hash_chain for the matching contract),
+    ``free_streams`` is the ``fits()``-derived admission capacity, and
+    ``fingerprint`` hashes the config axes (model, K, spec_k, kv dtype,
+    weight bits, page size) that make two replicas interchangeable.
+    """
+
+    model_id: str
+    fingerprint: str
+    page_size: int
+    window: int           # fused decode window K
+    spec_k: int
+    kv_dtype: str
+    weight_bits: int
+    max_slots: int
+    free_streams: int
+    used_pages: int
+    free_pages: int
+    total_pages: int
+    prefix_pages: int
+    hbm_used_bytes: int
+    hbm_limit_bytes: int
+    adapters: list[str]
+    prefixes: list[list[Any]]  # [chain: str, token_len: int, pages: int]
+    seq: int
+    unix_ts: float
+
+
+# ---------------------------------------------------------------------------
 # Logging
 # ---------------------------------------------------------------------------
 

@@ -110,6 +110,16 @@ class QueryAlerts:
 
 
 @message
+class QueryFleet:
+    """Fetch the merged fleet view of a dataflow (latest per-replica
+    engine-state digests with ages, clock-aligned across machines).
+    Resolution mirrors QueryMetrics."""
+
+    dataflow_uuid: str | None = None
+    name: str | None = None
+
+
+@message
 class MigrateNode:
     """Drain a serving node's live KV streams at a window boundary and
     re-admit them on another engine: the node quiesces, serializes its
@@ -253,6 +263,12 @@ class AlertsReply:
 
 
 @message
+class FleetReply:
+    dataflow_uuid: str
+    fleet: dict[str, Any]  # merged view (dora_tpu.fleet.merge_fleet_snapshots)
+
+
+@message
 class DaemonConnectedReply:
     connected: bool
 
@@ -352,6 +368,11 @@ class AlertsRequest:
 
 
 @message
+class FleetRequest:
+    dataflow_id: str
+
+
+@message
 class Heartbeat:
     pass
 
@@ -439,6 +460,13 @@ class AlertsReplyFromDaemon:
     dataflow_id: str
     machine_id: str
     alerts: dict[str, Any]  # per-machine status (Daemon.alerts_snapshot)
+
+
+@message
+class FleetReplyFromDaemon:
+    dataflow_id: str
+    machine_id: str
+    fleet: dict[str, Any]  # per-machine snapshot (Daemon.fleet_snapshot)
 
 
 @message

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from dora_tpu.message.common import Metadata
+from dora_tpu.message.common import EngineStateDigest, Metadata
 from dora_tpu.message.serde import message
 
 
@@ -102,6 +102,17 @@ class ReportServing:
 
 
 @message
+class ReportEngineState:
+    """Ship the serving node's fleet digest to the daemon (fleet
+    plane; control channel, fire-and-forget). The daemon keeps the
+    latest per node with a receive stamp — digest age is measured from
+    that stamp, so a wedged exporter shows up as a growing age even
+    while the node itself stays healthy."""
+
+    digest: EngineStateDigest
+
+
+@message
 class ReportProfile:
     """Deep-capture finished (or failed): the artifact path the serving
     node produced, forwarded by the daemon to the coordinator's waiting
@@ -154,5 +165,5 @@ def expects_reply(request: Any) -> bool:
     return not isinstance(
         request,
         (SendMessage, ReportDropTokens, ReportTrace, ReportServing,
-         ReportProfile),
+         ReportEngineState, ReportProfile),
     )

@@ -153,6 +153,14 @@ def flatten_snapshot(snap: dict) -> tuple[dict, dict, dict]:
             gauges[f"srv:{node}:adapter_streams:{name}"] = n
         ttft = s.get("ttft_us") or {}
         hists[f"srv:{node}:ttft_us"] = list(ttft.get("counts", []))
+    # Fleet plane: the per-replica digest-derived gauge block
+    # (daemon metrics_snapshot["fleet"], dora_tpu.fleet.fleet_gauges).
+    # The `fleet-digest-stale` default alert rule watches digest_age_s.
+    for node, f in snap.get("fleet", {}).items():
+        for name in ("digest_age_s", "free_streams", "used_pages",
+                     "total_pages", "occupancy", "prefix_pages"):
+            if f.get(name) is not None:
+                gauges[f"fleet:{node}:{name}"] = f[name]
     return counters, gauges, hists
 
 

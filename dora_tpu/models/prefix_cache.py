@@ -34,6 +34,19 @@ Token ids are exact-match keys (no hashing, no collisions): two
 prompts share a node only when their page-size chunk of token ids is
 identical, which is the greedy-exactness contract.
 
+The fleet plane additionally needs a *bounded, shippable* summary of
+what this cache holds, so a router can longest-prefix-match a prompt
+against remote replicas without shipping the tree. Every node carries a
+cumulative **hash chain** — ``blake2b(parent_chain || chunk token ids)``
+with the root seeded from the adapter identity, computed once at insert
+time (incremental, never re-walked) — and ``digest`` exports the top-N
+most-recently-used paths as ``(chain, token_len, pages)`` tuples. The
+hash is deterministic across processes (never Python's salted builtin
+``hash``), so a router hashing a prompt with ``prompt_hash_chain``
+produces byte-identical chains to compare against any replica's digest.
+Within the tree itself, hashing plays no role in correctness: matching
+stays exact on token ids.
+
 Multi-tenant LoRA serving adds an ``adapter`` dimension to that
 contract: the KV a stream computes depends on its adapter's weights,
 so two tenants with byte-identical prompts must NEVER share pages.
@@ -45,19 +58,57 @@ accounting walk all roots.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 
 
-class _Node:
-    __slots__ = ("key", "page", "children", "parent", "last_used", "pins")
+def _root_chain(adapter: str | None) -> str:
+    """Chain seed for an adapter's radix root. Seeding from the tenant
+    identity means two tenants' byte-identical prompts hash to different
+    chains — the digest inherits the cache's isolation contract."""
+    h = hashlib.blake2b(b"dora-prefix-root:", digest_size=8)
+    h.update((adapter or "").encode())
+    return h.hexdigest()
 
-    def __init__(self, key: tuple, page: int | None, parent: "_Node | None"):
+
+def _chain_hash(parent_chain: str, key) -> str:
+    """One incremental chain step: hash the parent's cumulative chain
+    plus this chunk's token ids. Deterministic across processes."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_chain.encode())
+    for t in key:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.hexdigest()
+
+
+def prompt_hash_chain(ids, page_size: int, adapter: str | None = None):
+    """Cumulative page-boundary chain of a prompt: one ``(chain,
+    token_len)`` pair per full page-size chunk, byte-identical to the
+    chains a replica's cache computes at insert. The router side of the
+    digest contract — see ``PrefixCache.digest``."""
+    chain = _root_chain(adapter)
+    out: list[tuple[str, int]] = []
+    ps = page_size
+    for i in range(0, (len(ids) // ps) * ps, ps):
+        chain = _chain_hash(chain, tuple(ids[i : i + ps]))
+        out.append((chain, i + ps))
+    return out
+
+
+class _Node:
+    __slots__ = (
+        "key", "page", "children", "parent", "last_used", "pins", "chain",
+    )
+
+    def __init__(self, key: tuple, page: int | None, parent: "_Node | None",
+                 chain: str = ""):
         self.key = key          # edge label: page_size token ids
         self.page = page        # physical page id (None only at root)
         self.children: dict[tuple, _Node] = {}
         self.parent = parent
         self.last_used = 0
         self.pins = 0
+        self.chain = chain      # cumulative blake2b chain root..here
 
 
 class PrefixCache:
@@ -70,7 +121,7 @@ class PrefixCache:
         #: optional hard cap on cached pages (0 = bounded only by pool
         #: pressure); insert evicts LRU past it
         self.max_pages = max_pages
-        self._root = _Node((), None, None)
+        self._root = _Node((), None, None, _root_chain(None))
         #: adapter identity -> radix root; None/"" is the base tenant.
         #: Tenant isolation lives here: lookups only ever walk their
         #: own adapter's tree, so cross-tenant hits are structurally
@@ -101,7 +152,7 @@ class PrefixCache:
     def _root_for(self, adapter: str | None, create: bool = False) -> _Node:
         root = self._roots.get(adapter or None)
         if root is None:
-            root = _Node((), None, None)
+            root = _Node((), None, None, _root_chain(adapter or None))
             if create:
                 self._roots[adapter or None] = root
         return root
@@ -151,7 +202,7 @@ class PrefixCache:
         for key, page in zip(self._chunks(ids), pages):
             child = node.children.get(key)
             if child is None:
-                child = _Node(key, page, node)
+                child = _Node(key, page, node, _chain_hash(node.chain, key))
                 node.children[key] = child
                 self.allocator.ref([page])
                 self.size += 1
@@ -270,6 +321,29 @@ class PrefixCache:
             n = stack.pop()
             stack.extend(n.children.values())
             yield n.page
+
+    def digest(self, top_n: int = 32) -> list[tuple[str, int, int]]:
+        """Bounded fleet digest: the top-``top_n`` most-recently-used
+        cached prefixes across all tenants, each as ``(chain,
+        token_len, pages)``. Chains were computed incrementally at
+        insert, so this is a walk plus a sort — no hashing here. A
+        router matches a prompt by comparing ``prompt_hash_chain``
+        output against these tuples (longest equal chain wins)."""
+        entries: list[tuple[int, str, int]] = []
+        stack = [
+            (c, 1)
+            for root in self._roots.values()
+            for c in root.children.values()
+        ]
+        while stack:
+            n, depth = stack.pop()
+            stack.extend((c, depth + 1) for c in n.children.values())
+            entries.append((n.last_used, n.chain, depth))
+        entries.sort(reverse=True)
+        return [
+            (chain, depth * self.page_size, depth)
+            for _, chain, depth in entries[:top_n]
+        ]
 
     def stats(self) -> dict:
         total = self.hits + self.misses

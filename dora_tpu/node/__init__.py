@@ -383,6 +383,14 @@ class Node:
         self._control.queue(n2d.ReportServing(snapshot=dict(snapshot)))
         self._control.flush()
 
+    def report_engine_state(self, digest) -> None:
+        """Ship an engine-state digest (message.common.EngineStateDigest)
+        to the daemon, fire-and-forget on the control channel — the
+        fleet plane's node-side entry point (serving nodes call this on
+        the DORA_FLEET_DIGEST_S cadence; see nodehub/llm_server)."""
+        self._control.queue(n2d.ReportEngineState(digest=digest))
+        self._control.flush()
+
     def report_profile(self, artifact: str, error: str | None = None) -> None:
         """Report a finished deep-capture's artifact path (or failure)
         to the daemon, fire-and-forget — it forwards to the

@@ -392,7 +392,8 @@ class AdmissionQueue:
 def _run_loop(node, engine, backlog, metrics, handle_input, emit,
               report, clock=time.monotonic, on_tick=None, on_step=None,
               handle_migrate=None, handle_profile=None,
-              on_engine_error=None, keep_alive=False) -> None:
+              on_engine_error=None, keep_alive=False,
+              fleet_tick=None) -> None:
     """Window-granular serving loop, factored out of :func:`main` so
     tests can drive it with fake nodes/engines. Each iteration: drain
     one event, run one engine step (one prefill chunk + one K-tick
@@ -479,6 +480,11 @@ def _run_loop(node, engine, backlog, metrics, handle_input, emit,
         if now - report_last >= 1.0:
             report(now)
             report_last = now
+        elif fleet_tick is not None:
+            # Fleet digests can run FASTER than the 1 Hz metrics report
+            # (DORA_FLEET_DIGEST_S below 1); report() itself also ticks
+            # the publisher, so the slow cadence costs nothing extra.
+            fleet_tick(now)
 
 
 def serve(node, engine, metrics, *, encode, decode_one, eos=None,
@@ -1079,6 +1085,19 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
                 except Exception:
                     pass
 
+    # Fleet plane: publish this engine's state digest on its own cadence
+    # (DORA_FLEET_DIGEST_S; 0 disables), piggybacked on the report path
+    # so it never adds a wakeup to the serving loop.
+    from dora_tpu import fleet as _fleet
+
+    fleet_pub = _fleet.DigestPublisher(
+        node, engine, tracer=tracer, clock=clock,
+        hbm=lambda: (
+            getattr(metrics, "hbm_used_bytes", 0) or 0,
+            getattr(metrics, "hbm_limit_bytes", 0) or 0,
+        ),
+    )
+
     def report(now: float) -> None:
         metrics.slots_active = engine.active
         metrics.slots_total = engine.max_slots
@@ -1157,6 +1176,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             node.report_serving(metrics.snapshot())
         except Exception:
             pass  # metrics are best-effort; serving never blocks on them
+        fleet_pub.tick(now)
 
     # ------------------------------------------------------------------
     # elastic recovery: checkpoint/restore, drain-and-migrate, SIGTERM
@@ -1487,6 +1507,7 @@ def serve(node, engine, metrics, *, encode, decode_one, eos=None,
             handle_profile=handle_profile,
             on_engine_error=on_engine_error,
             keep_alive=bool(migrate_dir),
+            fleet_tick=fleet_pub.tick if fleet_pub.enabled else None,
         )
         clean = True
     finally:

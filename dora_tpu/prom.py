@@ -89,6 +89,10 @@ FAMILIES: dict[str, tuple[str, str]] = {
     "dora_node_log_errors_total": ("counter", "Error-level log lines per node (level-prefix parsed)"),
     "dora_node_log_warns_total": ("counter", "Warn-level log lines per node (level-prefix parsed)"),
     "dora_trace_dropped_events_total": ("counter", "Flight-recorder events lost to ring truncation per process"),
+    "dora_fleet_digest_age_s": ("gauge", "Seconds since the replica's last engine-state digest reached its daemon"),
+    "dora_fleet_free_streams": ("gauge", "fits()-derived streams the replica could admit right now"),
+    "dora_fleet_occupancy": ("gauge", "KV page-pool occupancy fraction (used/total) per replica"),
+    "dora_fleet_prefix_pages": ("gauge", "KV pages held by the replica's radix prefix cache at digest time"),
     "dora_alerts": ("gauge", "Active alert instances: 1 per (alertname, instance) in state pending or firing"),
     "dora_alert_firing_total": ("counter", "Pending-to-firing transitions per alert rule"),
     "dora_alert_resolved_total": ("counter", "Firing-to-resolved transitions per alert rule"),
@@ -237,6 +241,12 @@ def iter_samples(
                 {**base, "process": proc},
                 c,
             )
+        for node, f in snap.get("fleet", {}).items():
+            labels = {**base, "node": node}
+            yield "dora_fleet_digest_age_s", labels, f.get("digest_age_s", 0) or 0
+            yield "dora_fleet_free_streams", labels, f.get("free_streams", 0) or 0
+            yield "dora_fleet_occupancy", labels, f.get("occupancy", 0) or 0
+            yield "dora_fleet_prefix_pages", labels, f.get("prefix_pages", 0) or 0
         alerts = snap.get("alerts") or {}
         for name, entry in alerts.get("rules", {}).items():
             for instance, inst in (entry.get("instances") or {}).items():
@@ -455,6 +465,17 @@ def _sample_snapshots() -> dict[str, dict[str, Any]]:
                     "adapter_stalls": 3,
                     "adapter_streams": {"tenant-a": 2, 'b "quoted"': 1},
                     "ttft_us": hist.snapshot(),
+                }
+            },
+            "fleet": {
+                "llm": {
+                    "digest_age_s": 1.4,
+                    "free_streams": 2,
+                    "used_pages": 48,
+                    "total_pages": 64,
+                    "occupancy": 0.75,
+                    "prefix_pages": 20,
+                    "seq": 9,
                 }
             },
             "logs": {"llm": {"errors": 2, "warns": 5}},

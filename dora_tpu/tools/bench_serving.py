@@ -721,6 +721,97 @@ def _profiling_ab() -> dict:
     }
 
 
+def _serve_ticked(engine, prompts, max_new: int, tick) -> tuple[int, float]:
+    """The ``_serve`` drain loop with a per-iteration ``tick()`` hook —
+    where the production serving loop would tick its fleet digest
+    publisher. Both A/B arms run THIS loop so the hook's call overhead
+    is common-mode; only the publish work differs."""
+    backlog = deque(enumerate(prompts))
+    t0 = time.perf_counter()
+    tokens = 0
+    active_keys: set[int] = set()
+    while backlog or active_keys:
+        tick()
+        while backlog and engine.can_admit(len(backlog[0][1]), max_new):
+            rid, ids = backlog.popleft()
+            active_keys.add(rid)
+            res = engine.submit(str(rid), ids, max_new)
+            if res is not None:
+                tokens += 1
+                if res[1]:
+                    active_keys.discard(rid)
+        for key, _token, done in engine.step():
+            tokens += 1
+            if done:
+                active_keys.discard(int(key))
+    return tokens, time.perf_counter() - t0
+
+
+def _fleet_digest_ab() -> dict:
+    """Fleet-digest A/B behind ``--fleet-digest-ab``: the engine-state
+    exporter (dora_tpu/fleet.py build_digest — radix-tree top-N walk,
+    fits()-derived capacity, fingerprint) publishing at an aggressive
+    0.5 s cadence vs off, on the 16-stream stub serving leg, trials
+    interleaved with the ``_profiling_ab`` paired-ratio methodology
+    (median of per-trial on/off ratios; ambient drift divides out).
+    The cadence is 4x the shipped default (DORA_FLEET_DIGEST_S=2), so
+    the gate bounds a worst-plausible config, not the default. Gate:
+    <= 3% wall-clock overhead — same bar as the other default-on
+    observability planes. The prefix cache is ON so every digest walks
+    a populated tree (the expensive path), and the publisher sinks into
+    a node fake — wire cost is the metrics plane's, already gated."""
+    from dora_tpu import fleet
+    from dora_tpu.models.batch_engine import make_stub_paged_engine
+
+    max_seq, page_size, chunk, max_new, streams = 256, 8, 16, 192, 16
+    cadence_s = 0.5
+    prompts = [[i + 5] for i in range(streams)]
+    trials = int(os.environ.get("DORA_BENCH_TRIALS", "14"))
+    engine = make_stub_paged_engine(
+        max_slots=streams, max_seq=max_seq, page_size=page_size,
+        chunk=chunk, window=8, prefix_cache=True,
+    )
+    _serve(engine, prompts, 4)  # warmup: compile + warm the radix tree
+
+    class _Sink:
+        def __init__(self):
+            self.digests = 0
+
+        def report_engine_state(self, digest):
+            self.digests += 1
+
+    published = 0
+    walls: dict[str, list[float]] = {"off": [], "on": []}
+    for i in range(trials):
+        for mode in (("off", "on") if i % 2 == 0 else ("on", "off")):
+            sink = _Sink()
+            pub = fleet.DigestPublisher(
+                sink, engine, model_id="stub",
+                interval_s=cadence_s if mode == "on" else 0,
+            )
+            _, wall = _serve_ticked(engine, prompts, max_new, pub.tick)
+            walls[mode].append(wall)
+            published += sink.digests
+    ratios = [
+        on / off
+        for off, on in zip(walls["off"], walls["on"])
+        if off > 0
+    ]
+    overhead = (statistics.median(ratios) - 1.0) * 100.0 if ratios else 0.0
+    return {
+        "streams": streams,
+        "max_new": max_new,
+        "trials": trials,
+        "cadence_s": cadence_s,
+        "digests_published": published,
+        "digest_off_wall_s": round(statistics.median(walls["off"]), 4),
+        "digest_on_wall_s": round(statistics.median(walls["on"]), 4),
+        "overhead_pct": round(overhead, 2),
+        "gate_pct": 3.0,
+        "pass": overhead <= 3.0,
+    }
+
+
 class _OpenLoopNode:
     """Node fake feeding serve() a pre-scheduled open-loop arrival
     trace: recv() releases an event once its arrival time has passed —
@@ -1071,6 +1162,11 @@ def main() -> int:
         # Stub-engine leg: the monitor's cost is per-window host work
         # (block_until_ready + counter math), independent of weights.
         print(json.dumps({"profiling_ab": _profiling_ab()}))
+        return 0
+    if "--fleet-digest-ab" in sys.argv[1:]:
+        # Stub-engine leg: digest cost is host-side scheduler reads
+        # (radix walk, allocator counters), independent of weights.
+        print(json.dumps({"fleet_digest_ab": _fleet_digest_ab()}))
         return 0
     path = os.environ.get("DORA_HF_CHECKPOINT")
     real = bool(path)

@@ -64,6 +64,10 @@ WATCHED: dict[str, str] = {
     # when KV is quantized — a downward drift means rounding started
     # flipping draft verifications.
     "serving_quant_ab.spec.spec_acceptance": "higher",
+    # Fleet-digest A/B: serving wall-clock with the engine-state
+    # exporter publishing at 0.5 s vs off — a drift upward means the
+    # digest walk crept onto the decode path (the gate is <= 3%).
+    "fleet_digest_ab.overhead_pct": "lower",
     # Multi-tenant LoRA: aggregate tok/s of one N-adapter engine vs N
     # single-tenant engines in the same HBM budget — a drift toward
     # 1.0 means the shared fused window stopped amortizing across

@@ -105,6 +105,7 @@ INSTANT_NAMES = {
     "alert_pending": "alert pending",
     "alert_firing": "alert firing",
     "alert_resolved": "alert resolved",
+    "fleet_digest": "fleet digest",
 }
 
 #: Instants that belong on the engine track and may carry a request

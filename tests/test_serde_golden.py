@@ -156,6 +156,20 @@ def test_alerts_messages_are_registered():
         assert name in _REGISTRY, name
 
 
+def test_fleet_messages_are_registered():
+    """The fleet-state quartet plus the digest itself must be wire
+    types — same rationale as the quartets above."""
+    for name in (
+        "QueryFleet",
+        "FleetReply",
+        "FleetRequest",
+        "FleetReplyFromDaemon",
+        "ReportEngineState",
+        "EngineStateDigest",
+    ):
+        assert name in _REGISTRY, name
+
+
 def test_unknown_tag_decodes_as_plain_dict_in_both_paths():
     wire = {"t": "NotARegisteredMessage", "f": {"x": 1}}
     raw = msgpack.packb(wire, use_bin_type=True)
